@@ -1,0 +1,12 @@
+"""Test-session setup: force JAX onto the host CPU backend with 8 virtual
+devices so multi-chip sharding paths compile and execute without TPUs.
+Must run before anything imports jax."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,38 @@
+"""One-time conversion of the reference's CSV golden fixtures
+(``/root/reference/tests/*.csv``, header ``consensus,edits,sequence`` with
+``;``-joined chains) into this repo's JSON fixture schema
+(``tests/data/*.json``).  The fixtures are *data* (input reads plus
+expected consensus assignments), reused as golden tests per SURVEY.md §4.
+
+Run from the repo root:  python scripts/convert_fixtures.py
+"""
+
+import csv
+import json
+import pathlib
+
+SRC = pathlib.Path("/root/reference/tests")
+DST = pathlib.Path(__file__).resolve().parent.parent / "tests" / "data"
+
+
+def main() -> None:
+    DST.mkdir(parents=True, exist_ok=True)
+    for path in sorted(SRC.glob("*.csv")):
+        records = []
+        with open(path, newline="") as fh:
+            for row in csv.DictReader(fh):
+                records.append(
+                    {
+                        "consensus": int(row["consensus"]),
+                        "edits": int(row["edits"]),
+                        "chain": row["sequence"].split(";"),
+                    }
+                )
+        out = DST / (path.stem + ".json")
+        with open(out, "w") as fh:
+            json.dump({"source": path.name, "records": records}, fh, indent=1)
+        print(f"wrote {out} ({len(records)} records)")
+
+
+if __name__ == "__main__":
+    main()

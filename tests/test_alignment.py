@@ -1,0 +1,57 @@
+"""Tests for the one-shot WFA edit distance (parity with the reference
+doctests, ``/root/reference/src/sequence_alignment.rs:9-35``, plus DP
+cross-checks)."""
+
+import numpy as np
+
+from waffle_con_tpu.ops.alignment import wfa_ed, wfa_ed_config
+from tests.test_dwfa import dp_edit_distance
+
+
+def test_doc_examples():
+    v1 = bytes([0, 1, 2, 4, 5])
+    v2 = bytes([0, 1, 3, 4, 5])
+    v3 = bytes([1, 2, 3, 5])
+    assert wfa_ed(v1, v1) == 0
+    assert wfa_ed(v1, v2) == 1
+    assert wfa_ed(v1, v3) == 2
+
+
+def test_prefix_mode():
+    v1 = bytes([0, 1, 2, 4, 5])
+    v2 = bytes([0, 1, 2, 4])
+    assert wfa_ed_config(v1, v2, False, ord("*")) == 0
+    assert wfa_ed_config(v1, v2, True, ord("*")) == 1
+
+
+def test_empty():
+    assert wfa_ed_config(b"", b"", True, None) == 0
+    assert wfa_ed_config(b"ABC", b"", False, None) == 0
+    assert wfa_ed_config(b"ABC", b"", True, None) == 3
+    assert wfa_ed_config(b"", b"ABC", True, None) == 3
+
+
+def test_wildcard_either_side():
+    assert wfa_ed_config(b"A*C", b"AXC", True, ord("*")) == 0
+    assert wfa_ed_config(b"AXC", b"A*C", True, ord("*")) == 0
+
+
+def test_random_parity_with_dp():
+    rng = np.random.default_rng(11)
+    for _ in range(50):
+        n = int(rng.integers(0, 50))
+        m = int(rng.integers(0, 50))
+        a = bytes(rng.integers(0, 4, size=n, dtype=np.uint8))
+        b = bytes(rng.integers(0, 4, size=m, dtype=np.uint8))
+        assert wfa_ed_config(a, b, True, None) == dp_edit_distance(a, b)
+
+
+def test_prefix_mode_is_min_over_prefixes():
+    rng = np.random.default_rng(12)
+    for _ in range(25):
+        n = int(rng.integers(1, 30))
+        m = int(rng.integers(1, 20))
+        a = bytes(rng.integers(0, 4, size=n, dtype=np.uint8))
+        b = bytes(rng.integers(0, 4, size=m, dtype=np.uint8))
+        expected = min(dp_edit_distance(a[:k], b) for k in range(n + 1))
+        assert wfa_ed_config(a, b, False, None) == expected

@@ -1,0 +1,190 @@
+"""End-to-end tests for the single-consensus engine, mirroring the
+reference suite (``/root/reference/src/consensus.rs:572-852``): exact
+expected results including per-read scores, tie ordering, wildcards,
+early termination, offset windows, and the coverage-gap error string."""
+
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    Consensus,
+    ConsensusDWFA,
+    ConsensusCost,
+)
+from waffle_con_tpu.models.consensus import EngineError
+
+
+def test_doc_example():
+    cdwfa = ConsensusDWFA()
+    for s in [b"ACGT", b"ACCGT", b"ACCCGT"]:
+        cdwfa.add_sequence(s)
+    consensus = cdwfa.consensus()
+    assert len(consensus) == 1
+    assert consensus[0].sequence == b"ACCGT"
+    assert consensus[0].scores == [1, 0, 1]
+
+
+def test_single_sequence():
+    sequence = b"ACGTACGTACGT"
+    cdwfa = ConsensusDWFA()
+    cdwfa.add_sequence(sequence)
+    assert len(cdwfa.alphabet) == 4
+    assert cdwfa.consensus() == [
+        Consensus(sequence, ConsensusCost.L1_DISTANCE, [0])
+    ]
+
+
+def test_dual_sequence_tie():
+    sequence = b"ACGTACGTACGT"
+    sequence2 = b"ACGTACCTACGT"
+    cdwfa = ConsensusDWFA()
+    cdwfa.add_sequence(sequence)
+    cdwfa.add_sequence(sequence2)
+    # tie between the two inputs; lexicographic result order
+    assert cdwfa.consensus() == [
+        Consensus(sequence2, ConsensusCost.L1_DISTANCE, [1, 0]),
+        Consensus(sequence, ConsensusCost.L1_DISTANCE, [0, 1]),
+    ]
+
+
+def test_trio_sequence():
+    sequence = b"ACGTACGTACGT"
+    sequence2 = b"ACGTACCTACGT"
+    cdwfa = ConsensusDWFA()
+    cdwfa.add_sequence(sequence)
+    cdwfa.add_sequence(sequence)
+    cdwfa.add_sequence(sequence2)
+    assert cdwfa.consensus() == [
+        Consensus(sequence, ConsensusCost.L1_DISTANCE, [0, 0, 1])
+    ]
+
+
+def test_complicated():
+    expected = b"ACGTACGTACGT"
+    sequences = [b"ACTACGGTACGT", b"ACGTAAGTCCGT", b"AAGTACGTACGT"]
+    cdwfa = ConsensusDWFA()
+    for s in sequences:
+        cdwfa.add_sequence(s)
+    consensus = cdwfa.consensus()
+    assert len(consensus) == 1
+    assert consensus[0].sequence == expected
+
+
+def test_wildcards():
+    expected = b"ACGTACGTACGT"
+    sequences = [b"ACGTACCGT****", b"**GTATGTAC**", b"****ACGTACGT"]
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).build()
+    )
+    for s in sequences:
+        cdwfa.add_sequence(s)
+    assert len(cdwfa.alphabet) == 4
+    consensus = cdwfa.consensus()
+    assert len(consensus) == 1
+    assert consensus[0].sequence == expected
+    assert consensus[0].scores == [1, 1, 0]
+
+
+def test_all_wildcards():
+    actual_consensus = b"*CGTACG*ACG*"
+    sequences = [b"*CGTAACG*ACG*", b"*CGTACG*ACG*", b"*CGTACG*ATG*"]
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).build()
+    )
+    for s in sequences:
+        cdwfa.add_sequence(s)
+    consensus = cdwfa.consensus()
+    assert len(consensus) == 1
+    assert consensus[0].sequence == actual_consensus
+    assert consensus[0].scores == [1, 0, 1]
+
+
+def test_allow_early_termination_costs():
+    expected = b"ACGT"
+    # without early termination a prefix ladder cannot recover the full
+    # sequence
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).build()
+    )
+    for i in range(1, len(expected) + 1):
+        cdwfa.add_sequence(expected[:i])
+    assert cdwfa.consensus() == [
+        Consensus(b"AC", ConsensusCost.L1_DISTANCE, [1, 0, 1, 2]),
+        Consensus(b"ACG", ConsensusCost.L1_DISTANCE, [2, 1, 0, 1]),
+    ]
+
+    # with early termination the full sequence is free for short reads
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder()
+        .wildcard(ord("*"))
+        .allow_early_termination(True)
+        .build()
+    )
+    for i in range(1, len(expected) + 1):
+        cdwfa.add_sequence(expected[:i])
+    assert cdwfa.consensus() == [
+        Consensus(expected, ConsensusCost.L1_DISTANCE, [0, 0, 0, 0])
+    ]
+
+
+def test_offset_windows():
+    expected = b"ACGTACGTACGTACGT"
+    sequences = [b"ACGTACGTACGTACGT", b"ACGTACGTACGT", b"GTACGTACGT"]
+    offsets = [None, 4, 7]
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder()
+        .offset_window(1)
+        .offset_compare_length(4)
+        .build()
+    )
+    for sequence, offset in zip(sequences, offsets):
+        cdwfa.add_sequence_offset(sequence, offset)
+    consensus = cdwfa.consensus()
+    assert len(consensus) == 1
+    assert consensus[0].sequence == expected
+    assert consensus[0].scores == [0, 0, 0]
+
+
+def test_offset_gap_err():
+    sequences = [b"ACGTACGTACGTACGT", b"ACGTACGTACGTACGT"]
+    offsets = [None, 1000]
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder()
+        .offset_window(1)
+        .offset_compare_length(4)
+        .build()
+    )
+    for sequence, offset in zip(sequences, offsets):
+        cdwfa.add_sequence_offset(sequence, offset)
+    with pytest.raises(EngineError) as err:
+        cdwfa.consensus()
+    assert str(err.value) == "Finalize called on DWFA that was never initialized."
+
+
+def test_no_initial_sequence_err():
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder().auto_shift_offsets(False).build()
+    )
+    cdwfa.add_sequence_offset(b"ACGT", 10)
+    with pytest.raises(EngineError) as err:
+        cdwfa.consensus()
+    assert (
+        str(err.value)
+        == "Must have at least one initial offset of None to see the consensus."
+    )
+
+
+def test_l2_cost():
+    sequence = b"ACGTACGTACGT"
+    sequence2 = b"ACGTACCTACGT"
+    cdwfa = ConsensusDWFA(
+        CdwfaConfigBuilder()
+        .consensus_cost(ConsensusCost.L2_DISTANCE)
+        .build()
+    )
+    cdwfa.add_sequence(sequence)
+    cdwfa.add_sequence(sequence)
+    cdwfa.add_sequence(sequence2)
+    assert cdwfa.consensus() == [
+        Consensus(sequence, ConsensusCost.L2_DISTANCE, [0, 0, 1])
+    ]

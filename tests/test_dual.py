@@ -1,0 +1,369 @@
+"""End-to-end tests for the dual-consensus engine, mirroring the reference
+suite (``/root/reference/src/dual_consensus.rs:1352-2056``): splits,
+unequal lengths, noise-before-variation, multi-extension, equal-option
+ties, tail extension, ed-delta misassignment, and the JSON scenario
+fixtures."""
+
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    Consensus,
+    ConsensusCost,
+    DualConsensus,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.models.consensus import EngineError
+from waffle_con_tpu.models.dual_consensus import _DualNode
+from waffle_con_tpu.utils.fixtures import load_dual_fixture
+
+
+def run_fixture(name, include_consensus, config=None):
+    if config is None:
+        config = CdwfaConfigBuilder().wildcard(ord("*")).build()
+    sequences, expected = load_dual_fixture(
+        name, include_consensus, config.consensus_cost
+    )
+    engine = DualConsensusDWFA(config)
+    for sequence in sequences:
+        engine.add_sequence(sequence)
+    assert len(engine.alphabet) == 4
+    assert engine.consensus() == [expected]
+
+
+def dc(consensus1, scores1, consensus2=None, scores2=None, is_consensus1=None):
+    n = len(is_consensus1)
+    return DualConsensus(
+        Consensus(consensus1, ConsensusCost.L1_DISTANCE, scores1),
+        Consensus(consensus2, ConsensusCost.L1_DISTANCE, scores2)
+        if consensus2 is not None
+        else None,
+        is_consensus1,
+        [None] * n,
+        [None] * n,
+    )
+
+
+def test_doc_example():
+    sequences = [
+        b"TCCGT",
+        b"ACCGT",  # consensus 1
+        b"ACCGT",  # consensus 1
+        b"ACCAT",
+        b"CCGTAAT",
+        b"CGTAAAT",
+        b"CGTAAT",  # consensus 2
+        b"CGTAAT",  # consensus 2
+    ]
+    engine = DualConsensusDWFA()
+    for s in sequences:
+        engine.add_sequence(s)
+    results = engine.consensus()
+    assert len(results) == 1
+    assert results[0].consensus1 == Consensus(
+        b"ACCGT", ConsensusCost.L1_DISTANCE, [1, 0, 0, 1]
+    )
+    assert results[0].consensus2 == Consensus(
+        b"CGTAAT", ConsensusCost.L1_DISTANCE, [1, 1, 0, 0]
+    )
+    assert results[0].is_consensus1 == [
+        True, True, True, True, False, False, False, False,
+    ]
+
+
+def test_single_sequence():
+    sequence = b"ACGTACGTACGT"
+    engine = DualConsensusDWFA()
+    engine.add_sequence(sequence)
+    assert len(engine.alphabet) == 4
+    assert engine.consensus() == [
+        dc(sequence, [0], is_consensus1=[True])
+    ]
+
+
+def test_trio_sequence():
+    sequence = b"ACGTACGTACGT"
+    sequence2 = b"ACGTACCTACGT"
+    engine = DualConsensusDWFA()
+    engine.add_sequence(sequence)
+    engine.add_sequence(sequence)
+    engine.add_sequence(sequence2)
+    assert engine.consensus() == [
+        dc(sequence, [0, 0, 1], is_consensus1=[True, True, True])
+    ]
+
+
+def test_complicated():
+    expected = b"ACGTACGTACGT"
+    sequences = [b"ACTACGGTACGT", b"ACGTAAGTCCGT", b"AAGTACGTACGT"]
+    engine = DualConsensusDWFA()
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [
+        dc(expected, [2, 2, 1], is_consensus1=[True] * 3)
+    ]
+
+
+def test_wildcards():
+    expected = b"ACGTACGTACGT"
+    sequences = [b"ACGTACCGT****", b"**GTATGTAC**", b"****ACGTACGT"]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [
+        dc(expected, [1, 1, 0], is_consensus1=[True] * 3)
+    ]
+
+
+def test_all_wildcards():
+    actual = b"*CGTACG*ACG*"
+    sequences = [b"*CGTAACG*ACG*", b"*CGTACG*ACG*", b"*CGTACG*ATG*"]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [
+        dc(actual, [1, 0, 1], is_consensus1=[True] * 3)
+    ]
+
+
+def test_dual_sequence():
+    sequence = b"ACGT"
+    alt = b"AGGT"
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).build()
+    )
+    engine.add_sequence(sequence)
+    engine.add_sequence(alt)
+    assert engine.consensus() == [
+        dc(sequence, [0], alt, [0], is_consensus1=[True, False])
+    ]
+
+
+@pytest.mark.parametrize(
+    "sequence,alt",
+    [(b"ACGT", b"AGGTA"), (b"ACGTA", b"AGGT")],
+)
+def test_dual_unequal(sequence, alt):
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).build()
+    )
+    engine.add_sequence(sequence)
+    engine.add_sequence(alt)
+    assert engine.consensus() == [
+        dc(sequence, [0], alt, [0], is_consensus1=[True, False])
+    ]
+
+
+def test_dual_noise_before_variation():
+    con1 = b"ACGTACGTACGT"
+    con2 = b"ACGTACGTCCCT"
+    sequences = [
+        b"ACGTACGTACGT",
+        b"ACCGTACGTACGT",  # noisy C insert
+        b"ACGTACGTACGT",
+        b"ACGTACGTCCCT",
+        b"ACGTACGTCCCT",
+        b"ACCGTACGTCCCT",  # noisy C insert
+    ]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).max_queue_size(1000).build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [
+        dc(
+            con1,
+            [0, 1, 0],
+            con2,
+            [0, 0, 1],
+            is_consensus1=[True, True, True, False, False, False],
+        )
+    ]
+
+
+def test_multi_extension():
+    con1 = b"ACGTACGTACGT"
+    con2 = b"ACGTACGTCCCT"
+    sequences = [
+        b"ACGTACGTACGT",
+        b"ACGTACGTACGT",
+        b"ACGTACGTGCGT",  # A read as G: extra extension candidate
+        b"ACGTACGTCCCT",
+        b"ACGTACGTCCCT",
+        b"ACGTACGTGCCT",  # C read as G: extra extension candidate
+    ]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).max_queue_size(1000).build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [
+        dc(
+            con1,
+            [0, 0, 1],
+            con2,
+            [0, 0, 1],
+            is_consensus1=[True, True, True, False, False, False],
+        )
+    ]
+
+
+def test_equal_options():
+    sequences = [
+        b"ACGTACGTACGT",  # 00
+        b"ACGTCCGTCCGT",  # 11
+        b"ACGTACGTCCGT",  # 01
+        b"ACGTCCGTACGT",  # 10
+    ]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).max_queue_size(1000).build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    results = engine.consensus()
+    # six equally-good dual splits, each with total ED 2
+    assert len(results) == 6
+    for r in results:
+        assert r.consensus2 is not None
+        total = sum(r.consensus1.scores) + sum(r.consensus2.scores)
+        assert total == 2
+
+
+def test_tail_extension():
+    # a 1bp tail difference does not create a dual split, only a tie
+    con1 = b"ACGT"
+    con2 = b"ACGTT"
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).max_queue_size(1000).build()
+    )
+    engine.add_sequence(con1)
+    engine.add_sequence(con2)
+    assert engine.consensus() == [
+        dc(con1, [0, 1], is_consensus1=[True, True]),
+        dc(con2, [1, 0], is_consensus1=[True, True]),
+    ]
+
+
+def test_csv_dual_001():
+    run_fixture("dual_001", True)
+
+
+def test_dual_max_ed_delta():
+    # restricting dual_max_ed_delta to 0 mis-assigns the third read
+    sequences, expected = load_dual_fixture(
+        "dual_001", True, ConsensusCost.L1_DISTANCE
+    )
+    expected = DualConsensus(
+        Consensus(
+            expected.consensus1.sequence,
+            ConsensusCost.L1_DISTANCE,
+            [0, 4, 4, 2],
+        ),
+        Consensus(
+            expected.consensus2.sequence,
+            ConsensusCost.L1_DISTANCE,
+            [3, 0, 0, 0, 0, 0],
+        ),
+        [True, True, False, True, True, False, False, False, False, False],
+        [None] * 10,
+        [None] * 10,
+    )
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).dual_max_ed_delta(0).build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [expected]
+
+
+def test_csv_length_gap_001():
+    run_fixture(
+        "length_gap_001",
+        False,
+        CdwfaConfigBuilder()
+        .wildcard(ord("*"))
+        .min_count(2)
+        .dual_max_ed_delta(5)
+        .max_queue_size(1000)
+        .consensus_cost(ConsensusCost.L2_DISTANCE)
+        .build(),
+    )
+
+
+def test_csv_early_termination_001():
+    run_fixture(
+        "dual_early_termination_001",
+        True,
+        CdwfaConfigBuilder()
+        .wildcard(ord("*"))
+        .allow_early_termination(True)
+        .build(),
+    )
+
+
+def test_offset_windows():
+    expected = b"ACGTACGTACGTACGT"
+    sequences = [b"ACGTACGTACGTACGT", b"ACGTACGTACGT", b"GTACGTACGT"]
+    offsets = [None, 4, 7]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().offset_window(1).offset_compare_length(4).build()
+    )
+    for sequence, offset in zip(sequences, offsets):
+        engine.add_sequence_offset(sequence, offset)
+    results = engine.consensus()
+    assert len(results) == 1
+    assert not results[0].is_dual()
+    assert results[0].consensus1.sequence == expected
+    assert results[0].consensus1.scores == [0, 0, 0]
+
+
+def test_offset_gap_err():
+    sequences = [b"ACGTACGTACGTACGT", b"ACGTACGTACGTACGT"]
+    offsets = [None, 1000]
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().offset_window(1).offset_compare_length(4).build()
+    )
+    for sequence, offset in zip(sequences, offsets):
+        engine.add_sequence_offset(sequence, offset)
+    with pytest.raises(EngineError) as err:
+        engine.consensus()
+    assert str(err.value) == "Finalize called on DWFA that was never initialized."
+
+
+def test_get_ed_weights():
+    # unit test of the vote-weight computation
+    # (parity: /root/reference/src/dual_consensus.rs:1362-1382)
+    import numpy as np
+
+    from waffle_con_tpu.config import CdwfaConfig
+    from waffle_con_tpu.ops.scorer import PythonScorer
+
+    sequences = [b"ACGT", b"CGTA"]
+    scorer = PythonScorer(sequences, CdwfaConfig(allow_early_termination=True))
+    node = _DualNode()
+    node.active1 = [True, True]
+    node.active2 = [False, False]
+    node.offsets1 = [0, 0]
+    node.offsets2 = [None, None]
+    node.h1 = scorer.root(np.array([True, True]))
+    node.stats1 = scorer.stats(node.h1, b"")
+
+    # emulate activate_dual with symbols A and C
+    node.is_dual = True
+    node.consensus2 = node.consensus1
+    node.h2 = scorer.clone(node.h1)
+    node.active2 = [True, True]
+    node.offsets2 = [0, 0]
+    node.consensus1 = b"A"
+    node.stats1 = scorer.push(node.h1, b"A")
+    node.consensus2 = b"C"
+    node.stats2 = scorer.push(node.h2, b"C")
+
+    assert node.ed_weights(True, True) == [1.0 / 1.5, 0.5 / 1.5]
+    assert node.ed_weights(False, True) == [0.5 / 1.5, 1.0 / 1.5]
+    assert node.ed_weights(True, False) == [1.0, 0.0]
+    assert node.ed_weights(False, False) == [0.0, 1.0]

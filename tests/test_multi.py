@@ -1,0 +1,19 @@
+"""Tests for the MultiConsensus result type
+(parity: ``/root/reference/src/multi_consensus.rs:73-94``)."""
+
+from waffle_con_tpu import Consensus, ConsensusCost, MultiConsensus
+
+
+def test_multiconsensus_sort():
+    consensuses = [
+        Consensus(b"ACGT", ConsensusCost.L1_DISTANCE, [0]),
+        Consensus(b"TGCA", ConsensusCost.L1_DISTANCE, [0]),
+        Consensus(b"AAAA", ConsensusCost.L1_DISTANCE, [0]),
+    ]
+    multicon = MultiConsensus(consensuses, [2, 0, 1])
+    assert multicon.consensuses == [
+        Consensus(b"AAAA", ConsensusCost.L1_DISTANCE, [0]),
+        Consensus(b"ACGT", ConsensusCost.L1_DISTANCE, [0]),
+        Consensus(b"TGCA", ConsensusCost.L1_DISTANCE, [0]),
+    ]
+    assert multicon.sequence_indices == [0, 1, 2]

@@ -1,0 +1,74 @@
+"""Tests for the queue tracker (parity with
+``/root/reference/src/pqueue_tracker.rs:150-171``) and the set-semantics
+priority queue."""
+
+import pytest
+
+from waffle_con_tpu.utils.pqueue import (
+    CapacityFullError,
+    PQueueTracker,
+    SetPriorityQueue,
+)
+
+
+def test_basic_capacity():
+    tracker = PQueueTracker(0, 2)
+    assert not tracker.at_capacity(1)
+    assert tracker.processed(1) == 0
+    tracker.process(1)
+    assert not tracker.at_capacity(1)
+    assert tracker.processed(1) == 1
+    tracker.process(1)
+    assert tracker.at_capacity(1)
+    assert tracker.processed(1) == 2
+    with pytest.raises(CapacityFullError):
+        tracker.process(1)
+    assert tracker.processed(1) == 2
+
+
+def test_threshold_accounting():
+    tracker = PQueueTracker(4, 10)
+    for v in [0, 0, 1, 2, 5]:
+        tracker.insert(v)
+    assert len(tracker) == 5
+    assert tracker.unfiltered_len() == 5
+    tracker.increment_threshold()  # drop the two zeros
+    assert len(tracker) == 3
+    assert tracker.unfiltered_len() == 5
+    tracker.increase_threshold(3)  # drop 1 and 2
+    assert len(tracker) == 1
+    tracker.remove(0)  # below threshold: unfiltered only
+    assert len(tracker) == 1
+    assert tracker.unfiltered_len() == 4
+    tracker.remove(5)
+    assert len(tracker) == 0
+    assert tracker.occupancy(0) == 1
+    assert tracker.occupancy(5) == 0
+    assert tracker.threshold() == 3
+
+
+def test_set_priority_queue_order():
+    q = SetPriorityQueue()
+    q.push("a", "a", (-3, 0))
+    q.push("b", "b", (-1, 0))
+    q.push("c", "c", (-1, 5))
+    q.push("d", "d", (-1, 5))
+    # best: lowest cost, then longest, then FIFO
+    assert q.pop()[0] == "c"
+    assert q.pop()[0] == "d"
+    assert q.pop()[0] == "b"
+    assert q.pop()[0] == "a"
+    assert q.is_empty()
+    with pytest.raises(IndexError):
+        q.pop()
+
+
+def test_set_priority_queue_duplicate_rejected():
+    q = SetPriorityQueue()
+    assert q.push("k", 1, (0, 0))
+    # a duplicate key is rejected; the original entry stays queued
+    assert not q.push("k", 2, (0, 0))
+    assert len(q) == 1
+    item, _ = q.pop()
+    assert item == 1
+    assert q.is_empty()

@@ -1,0 +1,139 @@
+"""End-to-end tests for the priority (chained multi) consensus engine,
+mirroring ``/root/reference/src/priority_consensus.rs:357-655``."""
+
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    Consensus,
+    ConsensusCost,
+    PriorityConsensus,
+    PriorityConsensusDWFA,
+)
+from waffle_con_tpu.models.consensus import EngineError
+from waffle_con_tpu.utils.fixtures import load_priority_fixture
+
+
+def run_fixture(name, include_consensus, config=None):
+    if config is None:
+        config = CdwfaConfigBuilder().wildcard(ord("*")).build()
+    chains, expected = load_priority_fixture(
+        name, include_consensus, config.consensus_cost
+    )
+    engine = PriorityConsensusDWFA(config)
+    for chain in chains:
+        engine.add_sequence_chain(chain)
+    assert len(engine.alphabet) == 4
+    result = engine.consensus()
+    assert result.sequence_indices == expected.sequence_indices
+    assert len(result.consensuses) == len(expected.consensuses)
+    for got_chain, want_chain in zip(result.consensuses, expected.consensuses):
+        assert len(got_chain) == len(want_chain)
+        for got, want in zip(got_chain, want_chain):
+            assert got.sequence == want.sequence
+
+
+def test_single_sequence():
+    sequence = b"ACGTACGTACGT"
+    engine = PriorityConsensusDWFA()
+    engine.add_sequence_chain([sequence, sequence])
+    assert len(engine.alphabet) == 4
+    assert engine.consensus() == PriorityConsensus(
+        [[Consensus(sequence, ConsensusCost.L1_DISTANCE, [0])] * 2],
+        [0],
+    )
+
+
+def test_doc_example():
+    chains = (
+        [[b"TCCGT", b"TCCGT"]] * 3
+        + [[b"TCCGT", b"ACGGT"]] * 3
+        + [[b"ACGT", b"ACCCGGTT"]] * 3
+    )
+    engine = PriorityConsensusDWFA()
+    for chain in chains:
+        engine.add_sequence_chain(chain)
+    result = engine.consensus()
+    assert result.consensuses == [
+        [
+            Consensus(b"ACGT", ConsensusCost.L1_DISTANCE, [0] * 3),
+            Consensus(b"ACCCGGTT", ConsensusCost.L1_DISTANCE, [0] * 3),
+        ],
+        [
+            Consensus(b"TCCGT", ConsensusCost.L1_DISTANCE, [0] * 6),
+            Consensus(b"ACGGT", ConsensusCost.L1_DISTANCE, [0] * 3),
+        ],
+        [
+            Consensus(b"TCCGT", ConsensusCost.L1_DISTANCE, [0] * 6),
+            Consensus(b"TCCGT", ConsensusCost.L1_DISTANCE, [0] * 3),
+        ],
+    ]
+    assert result.sequence_indices == [2, 2, 2, 1, 1, 1, 0, 0, 0]
+
+
+def test_chain_length_mismatch():
+    engine = PriorityConsensusDWFA()
+    engine.add_sequence_chain([b"ACGT", b"ACGT"])
+    with pytest.raises(EngineError):
+        engine.add_sequence_chain([b"ACGT"])
+    with pytest.raises(EngineError):
+        engine.add_sequence_chain([])
+
+
+def test_seeded_groups():
+    # seeds force an initial partition even when sequences agree
+    chains = [[b"ACGTACGT"]] * 6
+    engine = PriorityConsensusDWFA()
+    for i, chain in enumerate(chains):
+        engine.add_seeded_sequence_chain(chain, [None], i % 2)
+    result = engine.consensus()
+    assert len(result.consensuses) == 2
+    assert all(c[0].sequence == b"ACGTACGT" for c in result.consensuses)
+
+
+# fixture scenarios shared with the dual engine
+def test_csv_dual_001():
+    run_fixture("dual_001", True)
+
+
+def test_multi_exact_001():
+    run_fixture("multi_exact_001", True)
+
+
+def test_multi_exact_002():
+    run_fixture("multi_exact_002", True)
+
+
+def test_multi_err_001():
+    run_fixture("multi_err_001", False)
+
+
+def test_multi_err_002():
+    run_fixture("multi_err_002", False)
+
+
+def test_multi_samesplit_001():
+    # four reads with a unique symbol at one position: 4-way split
+    run_fixture("multi_samesplit_001", True)
+
+
+def test_multi_postcon_001():
+    # the split works but the group needs a re-polish to find its best
+    # consensus
+    run_fixture(
+        "multi_postcon_001",
+        True,
+        CdwfaConfigBuilder().wildcard(ord("*")).min_count(2).build(),
+    )
+
+
+def test_priority_001():
+    run_fixture("priority_001", True)
+
+
+def test_priority_002():
+    run_fixture("priority_002", True)
+
+
+def test_priority_003():
+    run_fixture("priority_003", True)

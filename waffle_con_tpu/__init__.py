@@ -1,0 +1,53 @@
+"""waffle_con_tpu — a TPU-native dynamic-WFA consensus framework.
+
+A ground-up rebuild of the capabilities of ``waffle_con``
+(PacificBiosciences, reference at ``/root/reference``): backbone-free
+consensus generation over sets of noisy long reads via a least-cost-first
+search whose per-read scoring step is an incremental edit-distance
+wavefront (dynamic WFA).
+
+Architecture (TPU-first, not a translation):
+
+* ``ops``      — the alignment kernels.  A pure-Python incremental DWFA
+  (:class:`~waffle_con_tpu.ops.dwfa.DWFALite`, parity oracle), a one-shot
+  WFA edit distance, and a batched JAX scorer that keeps every read's
+  wavefront as one ``[branch, read, 2*E+1]`` device array and advances all
+  of them in a single fused XLA step per consensus symbol.
+* ``models``   — the consensus engines (single, dual/diplotype,
+  priority-chain, multi).  Host-side Dijkstra-like search (priority queue,
+  candidate nomination, thresholds, offset activation) over an abstract
+  branch store so CPU and TPU scorers are interchangeable.
+* ``parallel`` — ``jax.sharding`` mesh utilities: reads sharded across
+  chips, candidate-vote histograms reduced with ``psum`` over ICI.
+* ``utils``    — configuration, priority-queue tracker, synthetic data
+  generation, golden-fixture loaders.
+* ``native``   — C++ implementations of the kernels and engines (the fast
+  CPU path and the benchmark baseline), bound via ctypes.
+
+Reference layer map: see SURVEY.md §1; the public API parity targets the
+reference's six modules (``/root/reference/src/lib.rs:38-55``).
+"""
+
+from waffle_con_tpu.config import CdwfaConfig, CdwfaConfigBuilder, ConsensusCost
+from waffle_con_tpu.models.consensus import Consensus, ConsensusDWFA
+from waffle_con_tpu.models.dual_consensus import DualConsensus, DualConsensusDWFA
+from waffle_con_tpu.models.multi_consensus import MultiConsensus
+from waffle_con_tpu.models.priority_consensus import (
+    PriorityConsensus,
+    PriorityConsensusDWFA,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CdwfaConfig",
+    "CdwfaConfigBuilder",
+    "ConsensusCost",
+    "Consensus",
+    "ConsensusDWFA",
+    "DualConsensus",
+    "DualConsensusDWFA",
+    "MultiConsensus",
+    "PriorityConsensus",
+    "PriorityConsensusDWFA",
+]

@@ -1,0 +1,117 @@
+"""Configuration for the consensus DWFA engines.
+
+Capability-parity with the reference config module
+(``/root/reference/src/cdwfa_config.rs:18-102``): same knobs, same
+defaults, plus a ``backend`` selector for the scorer implementation
+(``python`` oracle, ``native`` C++, or ``jax`` TPU) which the reference
+does not have (it is the whole point of this framework).
+
+Typical usage::
+
+    from waffle_con_tpu import CdwfaConfigBuilder, ConsensusCost
+
+    config = (
+        CdwfaConfigBuilder()
+        .consensus_cost(ConsensusCost.L2_DISTANCE)
+        .wildcard(ord("N"))
+        .build()
+    )
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class ConsensusCost(enum.Enum):
+    """Scoring model for a consensus (reference ``ConsensusCost``,
+    ``/root/reference/src/cdwfa_config.rs:18-24``)."""
+
+    #: Minimize the total edit distance across all sequences.
+    L1_DISTANCE = "l1"
+    #: Minimize the sum of squared edit distances across all sequences.
+    L2_DISTANCE = "l2"
+
+    def apply(self, edit_distance: int) -> int:
+        """Map a raw integer edit distance into this cost space."""
+        if self is ConsensusCost.L1_DISTANCE:
+            return edit_distance
+        return edit_distance * edit_distance
+
+
+@dataclasses.dataclass(frozen=True)
+class CdwfaConfig:
+    """Shared configuration for every consensus engine.
+
+    Field semantics and defaults mirror the reference
+    (``/root/reference/src/cdwfa_config.rs:40-102``).
+    """
+
+    #: The consensus scoring cost.
+    consensus_cost: ConsensusCost = ConsensusCost.L1_DISTANCE
+    #: Maximum queue size: how many active branches are allowed during
+    #: exploration (counted at or above the rising length threshold).
+    max_queue_size: int = 20
+    #: Maximum number of nodes *processed* at each consensus length.
+    max_capacity_per_size: int = 20
+    #: Maximum number of equally-good results tracked.
+    max_return_size: int = 10
+    #: Maximum explored nodes without constraining the queue threshold;
+    #: prevents hyper-branching in truly ambiguous regions.
+    max_nodes_wo_constraint: int = 1000
+    #: Minimum occurrences of a candidate extension to be used (the
+    #: largest-observed candidate is always eligible regardless).
+    min_count: int = 3
+    #: Minimum fraction of sequences voting for a candidate extension.
+    min_af: float = 0.0
+    #: For dual consensus: weight nominated extensions by relative edit
+    #: distance, accelerating convergence.
+    weighted_by_ed: bool = False
+    #: Optional wildcard symbol (byte value) that matches anything.
+    wildcard: Optional[int] = None
+    #: Dual-mode pruning threshold: when a read's two tracked wavefronts
+    #: diverge in edit distance by more than this, drop the worse one.
+    dual_max_ed_delta: int = 20
+    #: If true, input sequences shorter than the final consensus are not
+    #: penalized for the unmatched consensus tail.
+    allow_early_termination: bool = False
+    #: If true, shift all provided offsets down when none start at zero.
+    auto_shift_offsets: bool = True
+    #: Number of bases before the last offset searched for the optimal
+    #: start point of a late-activating sequence.
+    offset_window: int = 50
+    #: Number of bases compared when scoring candidate start points.
+    offset_compare_length: int = 50
+    #: Scorer backend: "python" (pure-Python oracle), "native" (C++),
+    #: or "jax" (batched TPU scorer).  Framework extension beyond the
+    #: reference config.
+    backend: str = "python"
+
+    def __post_init__(self) -> None:
+        if self.wildcard is not None and not 0 <= self.wildcard <= 255:
+            raise ValueError("wildcard must be a byte value (0..=255)")
+        if self.backend not in ("python", "native", "jax"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+
+
+class CdwfaConfigBuilder:
+    """Fluent builder for :class:`CdwfaConfig` (parity with the
+    reference's ``derive_builder`` API, ``CdwfaConfigBuilder``)."""
+
+    def __init__(self) -> None:
+        self._values: dict = {}
+
+    def build(self) -> CdwfaConfig:
+        return CdwfaConfig(**self._values)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or name not in CdwfaConfig.__dataclass_fields__:
+            raise AttributeError(name)
+
+        def setter(value):
+            self._values[name] = value
+            return self
+
+        return setter

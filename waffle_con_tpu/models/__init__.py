@@ -1,0 +1,21 @@
+"""Consensus engines (the framework's model families): single, dual,
+priority-chain, and multi consensus."""
+
+from waffle_con_tpu.models.consensus import Consensus, ConsensusDWFA, EngineError
+from waffle_con_tpu.models.dual_consensus import DualConsensus, DualConsensusDWFA
+from waffle_con_tpu.models.multi_consensus import MultiConsensus
+from waffle_con_tpu.models.priority_consensus import (
+    PriorityConsensus,
+    PriorityConsensusDWFA,
+)
+
+__all__ = [
+    "Consensus",
+    "ConsensusDWFA",
+    "DualConsensus",
+    "DualConsensusDWFA",
+    "EngineError",
+    "MultiConsensus",
+    "PriorityConsensus",
+    "PriorityConsensusDWFA",
+]

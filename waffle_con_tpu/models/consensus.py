@@ -1,0 +1,383 @@
+"""Single-consensus engine: least-cost-first search over partial consensus
+strings, scored by incremental per-read wavefronts.
+
+Capability parity with the reference engine
+(``/root/reference/src/consensus.rs:76-570``), re-architected over the
+:class:`~waffle_con_tpu.ops.scorer.WavefrontScorer` seam so the per-read
+scoring step runs on any backend (Python oracle, C++, batched JAX/TPU).
+
+Example::
+
+    from waffle_con_tpu import ConsensusDWFA
+
+    cdwfa = ConsensusDWFA()
+    for s in [b"ACGT", b"ACCGT", b"ACCCGT"]:
+        cdwfa.add_sequence(s)
+    results = cdwfa.consensus()
+    assert results[0].sequence == b"ACCGT"
+    assert results[0].scores == [1, 0, 1]
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.ops.scorer import (
+    BranchStats,
+    WavefrontScorer,
+    find_activation_offset,
+    make_scorer,
+)
+from waffle_con_tpu.utils.pqueue import PQueueTracker, SetPriorityQueue
+
+logger = logging.getLogger(__name__)
+
+
+class EngineError(Exception):
+    """Engine-level failure (coverage gaps, invalid inputs, ...).
+
+    The message strings for reference-visible failures are API surface and
+    match the reference exactly (asserted by tests; cf.
+    ``/root/reference/src/consensus.rs:850``)."""
+
+
+class Consensus:
+    """A final consensus result: the sequence, the cost model, and the
+    per-read scores (parity with ``/root/reference/src/consensus.rs:42-74``)."""
+
+    __slots__ = ("sequence", "consensus_cost", "scores")
+
+    def __init__(
+        self,
+        sequence: bytes,
+        consensus_cost: ConsensusCost,
+        scores: List[int],
+    ) -> None:
+        self.sequence = bytes(sequence)
+        self.consensus_cost = consensus_cost
+        self.scores = list(scores)
+
+    def __eq__(self, rhs) -> bool:
+        return (
+            isinstance(rhs, Consensus)
+            and self.sequence == rhs.sequence
+            and self.consensus_cost == rhs.consensus_cost
+            and self.scores == rhs.scores
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"Consensus(sequence={self.sequence!r}, "
+            f"cost={self.consensus_cost.value}, scores={self.scores})"
+        )
+
+
+def shift_offsets(
+    offsets: List[Optional[int]], auto_shift: bool
+) -> List[Optional[int]]:
+    """When no read starts at offset ``None`` and auto-shift is enabled,
+    shift every offset down by the minimum (the minimum becomes ``None``);
+    parity with ``/root/reference/src/consensus.rs:151-181``."""
+    if not auto_shift or any(o is None for o in offsets):
+        return list(offsets)
+    min_offset = min(offsets)
+    logger.debug("No start sequence detected, shifting all offsets by %d", min_offset)
+    return [None if o == min_offset else o - min_offset for o in offsets]
+
+
+def candidates_from_stats(
+    stats: BranchStats,
+    symtab: np.ndarray,
+    wildcard: Optional[int],
+    weights: Optional[Sequence[float]] = None,
+) -> Dict[int, float]:
+    """Fold per-read integer tip votes into fractional per-symbol votes.
+
+    Each read splits one unit of vote across its tip symbols
+    (``occ/split``), optionally scaled by a per-read weight; reads are
+    accumulated in index order so float summation is identical across
+    backends.  The wildcard is dropped whenever any other candidate exists
+    (parity with ``/root/reference/src/consensus.rs:540-564``).
+    """
+    votes: Dict[int, float] = {}
+    occ = stats.occ
+    split = stats.split
+    n, a = occ.shape
+    for r in range(n):
+        total = split[r]
+        if total == 0:
+            continue
+        w = 1.0 if weights is None else weights[r]
+        if w <= 0.0:
+            continue
+        row = occ[r]
+        for s in range(a):
+            c = row[s]
+            if c:
+                sym = int(symtab[s])
+                add = c / total if weights is None else w * c / total
+                votes[sym] = votes.get(sym, 0.0) + add
+    if wildcard is not None and len(votes) > 1:
+        votes.pop(wildcard, None)
+    return votes
+
+
+class _Node:
+    """A search node: a partial consensus plus its scorer branch."""
+
+    __slots__ = ("consensus", "handle", "active", "offsets", "stats")
+
+    def __init__(self, consensus, handle, active, offsets, stats):
+        self.consensus: bytes = consensus
+        self.handle: int = handle
+        self.active: List[bool] = active
+        self.offsets: List[Optional[int]] = offsets
+        self.stats: BranchStats = stats
+
+    def key(self) -> Tuple:
+        # Active wavefront state is a deterministic function of
+        # (read, consensus, offset), so this tuple is full-state identity.
+        return (self.consensus, tuple(self.offsets))
+
+    def total_cost(self, cost: ConsensusCost) -> int:
+        return sum(
+            cost.apply(int(e)) for e, a in zip(self.stats.eds, self.active) if a
+        )
+
+    def priority(self, cost: ConsensusCost) -> Tuple[int, int]:
+        # max-queue: smaller cost wins, then longer consensus
+        return (-self.total_cost(cost), len(self.consensus))
+
+
+class ConsensusDWFA:
+    """Generates the single best consensus (or the tied set) for the added
+    sequences."""
+
+    def __init__(self, config: Optional[CdwfaConfig] = None) -> None:
+        self.config = config if config is not None else CdwfaConfig()
+        self.sequences: List[bytes] = []
+        self.offsets: List[Optional[int]] = []
+        self.alphabet: set = set()
+
+    @classmethod
+    def with_config(cls, config: CdwfaConfig) -> "ConsensusDWFA":
+        return cls(config)
+
+    def add_sequence(self, sequence: bytes) -> None:
+        self.add_sequence_offset(sequence, None)
+
+    def add_sequence_offset(
+        self, sequence: bytes, last_offset: Optional[int]
+    ) -> None:
+        sequence = bytes(sequence)
+        self.alphabet.update(sequence)
+        if self.config.wildcard is not None:
+            self.alphabet.discard(self.config.wildcard)
+        self.sequences.append(sequence)
+        self.offsets.append(last_offset)
+
+    @property
+    def consensus_cost(self) -> ConsensusCost:
+        return self.config.consensus_cost
+
+    # ------------------------------------------------------------------
+
+    def consensus(self) -> List[Consensus]:
+        """Run the least-cost-first search and return every tied-best
+        consensus, lexicographically sorted.
+
+        Search skeleton parity: ``/root/reference/src/consensus.rs:139-351``.
+        """
+        cfg = self.config
+        cost = cfg.consensus_cost
+        maximum_error = math.inf
+        nodes_explored = 0
+        nodes_ignored = 0
+        peak_queue_size = 0
+        farthest_consensus = 0
+        last_constraint = 0
+
+        offsets = shift_offsets(self.offsets, cfg.auto_shift_offsets)
+        logger.debug("Offsets: %s", offsets)
+
+        # lengths at which late reads activate
+        activate_points: Dict[int, List[int]] = {}
+        max_activate = 0
+        initially_active = 0
+        for seq_index, offset in enumerate(offsets):
+            if offset is not None:
+                activate_length = offset + cfg.offset_compare_length
+                activate_points.setdefault(activate_length, []).append(seq_index)
+                max_activate = max(max_activate, activate_length)
+            else:
+                initially_active += 1
+        if initially_active == 0:
+            raise EngineError(
+                "Must have at least one initial offset of None to see the consensus."
+            )
+
+        scorer = make_scorer(self.sequences, cfg)
+        tracker = PQueueTracker(
+            max(len(s) for s in self.sequences), cfg.max_capacity_per_size
+        )
+        pqueue = SetPriorityQueue()
+
+        active = [o is None for o in offsets]
+        root_handle = scorer.root(np.array(active, dtype=bool))
+        root = _Node(
+            b"",
+            root_handle,
+            active,
+            [0 if a else None for a in active],
+            scorer.stats(root_handle, b""),
+        )
+        tracker.insert(0)
+        pqueue.push(root.key(), root, root.priority(cost))
+
+        results: List[Consensus] = []
+
+        while not pqueue.is_empty():
+            peak_queue_size = max(peak_queue_size, len(pqueue))
+
+            while (
+                len(tracker) > cfg.max_queue_size
+                or last_constraint >= cfg.max_nodes_wo_constraint
+            ) and tracker.threshold() < farthest_consensus:
+                tracker.increment_threshold()
+                last_constraint = 0
+
+            node, priority = pqueue.pop()
+            top_cost = -priority[0]
+            top_len = len(node.consensus)
+            tracker.remove(top_len)
+
+            if (
+                top_cost > maximum_error
+                or top_len < tracker.threshold()
+                or tracker.at_capacity(top_len)
+            ):
+                nodes_ignored += 1
+                scorer.free(node.handle)
+                continue
+
+            farthest_consensus = max(farthest_consensus, top_len)
+            nodes_explored += 1
+            last_constraint += 1
+            tracker.process(top_len)
+
+            # -- result check: any (or, with early termination, all) read
+            # touching its baseline end means this consensus may be complete
+            if self._reached_end(node, cfg.allow_early_termination):
+                if not all(node.active):
+                    scorer.free(node.handle)
+                    raise EngineError(
+                        "Finalize called on DWFA that was never initialized."
+                    )
+                fin_eds = scorer.finalized_eds(node.handle, node.consensus)
+                fin_scores = [cost.apply(int(e)) for e in fin_eds]
+                fin_total = sum(fin_scores)
+                if fin_total < maximum_error:
+                    maximum_error = fin_total
+                    results.clear()
+                if fin_total <= maximum_error and len(results) < cfg.max_return_size:
+                    results.append(Consensus(node.consensus, cost, fin_scores))
+
+            # -- nominate extensions
+            candidates = candidates_from_stats(
+                node.stats, scorer.symtab, cfg.wildcard
+            )
+            max_observed = max(candidates.values(), default=float(cfg.min_count))
+            active_threshold = min(float(cfg.min_count), max_observed)
+            passing = sorted(
+                sym for sym, count in candidates.items() if count >= active_threshold
+            )
+
+            new_nodes: List[_Node] = []
+            if not passing:
+                if top_len < max_activate:
+                    scorer.free(node.handle)
+                    raise EngineError(
+                        f"Encountered coverage gap: consensus is length {top_len} "
+                        f"with no candidates, but sequences activate at {max_activate}"
+                    )
+                scorer.free(node.handle)
+                # otherwise: dead end past all activations, drop the branch
+            elif len(passing) == 1:
+                # single extension: move the branch in place, no clone
+                consensus = node.consensus + bytes([passing[0]])
+                stats = scorer.push(node.handle, consensus)
+                node.consensus = consensus
+                node.stats = stats
+                new_nodes.append(node)
+            else:
+                specs = []
+                children = []
+                for sym in passing:
+                    handle = scorer.clone(node.handle)
+                    consensus = node.consensus + bytes([sym])
+                    specs.append((handle, consensus))
+                    children.append(
+                        _Node(
+                            consensus,
+                            handle,
+                            list(node.active),
+                            list(node.offsets),
+                            None,
+                        )
+                    )
+                for child, stats in zip(children, scorer.push_many(specs)):
+                    child.stats = stats
+                    new_nodes.append(child)
+                scorer.free(node.handle)
+
+            for child in new_nodes:
+                activate_list = activate_points.get(len(child.consensus))
+                if activate_list:
+                    for seq_index in activate_list:
+                        self._activate(scorer, child, seq_index)
+                    child.stats = scorer.stats(child.handle, child.consensus)
+                tracker.insert(len(child.consensus))
+                if not pqueue.push(child.key(), child, child.priority(cost)):
+                    # identical node already queued (cannot normally happen:
+                    # a consensus string uniquely identifies its path)
+                    logger.warning("duplicate search node %r", child.consensus)
+                    tracker.remove(len(child.consensus))
+                    scorer.free(child.handle)
+
+        assert len(tracker) == 0
+
+        results.sort(key=lambda c: c.sequence)
+        logger.debug("nodes_explored: %d", nodes_explored)
+        logger.debug("nodes_ignored: %d", nodes_ignored)
+        logger.debug("peak_queue_size: %d", peak_queue_size)
+        return results
+
+    # ------------------------------------------------------------------
+
+    def _reached_end(self, node: _Node, require_all: bool) -> bool:
+        flags = [
+            bool(r) if a else False
+            for r, a in zip(node.stats.reached, node.active)
+        ]
+        return all(flags) if require_all else any(flags)
+
+    def _activate(
+        self, scorer: WavefrontScorer, node: _Node, seq_index: int
+    ) -> None:
+        assert not node.active[seq_index]
+        cfg = self.config
+        offset = find_activation_offset(
+            node.consensus,
+            self.sequences[seq_index],
+            cfg.offset_window,
+            cfg.offset_compare_length,
+            cfg.wildcard,
+        )
+        scorer.activate(node.handle, seq_index, offset, node.consensus)
+        node.active[seq_index] = True
+        node.offsets[seq_index] = offset

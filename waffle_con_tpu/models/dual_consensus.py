@@ -1,0 +1,743 @@
+"""Dual-consensus engine: finds the one *or two* best consensuses for a
+set of reads (e.g. the two haplotypes of a diplotype).
+
+Capability parity with ``/root/reference/src/dual_consensus.rs:52-1350``,
+over the scorer seam: a search node carries one or two consensus branches;
+non-dual nodes may *split* into dual nodes whenever two extension symbols
+both gather enough votes, and each read's pair of wavefronts is pruned to
+one side once their edit distances diverge beyond ``dual_max_ed_delta`` —
+that emergent pruning is what assigns reads to haplotypes.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.models.consensus import (
+    Consensus,
+    EngineError,
+    candidates_from_stats,
+    shift_offsets,
+)
+from waffle_con_tpu.ops.scorer import (
+    WavefrontScorer,
+    find_activation_offset,
+    make_scorer,
+)
+from waffle_con_tpu.utils.pqueue import PQueueTracker, SetPriorityQueue
+
+logger = logging.getLogger(__name__)
+
+
+class DualConsensus:
+    """A dual (or degenerate single) consensus result.
+
+    ``is_consensus1[i]`` says whether input read ``i`` is assigned to
+    ``consensus1``; ``scores1``/``scores2`` hold the per-read costs against
+    each consensus, ``None`` where tracking was pruned.  Equality ignores
+    the score vectors (parity with
+    ``/root/reference/src/dual_consensus.rs:66-75``).
+    """
+
+    __slots__ = ("consensus1", "consensus2", "is_consensus1", "scores1", "scores2")
+
+    def __init__(
+        self,
+        consensus1: Consensus,
+        consensus2: Optional[Consensus],
+        is_consensus1: List[bool],
+        scores1: List[Optional[int]],
+        scores2: List[Optional[int]],
+    ) -> None:
+        if len(is_consensus1) != len(scores1) or len(is_consensus1) != len(scores2):
+            raise EngineError(
+                "is_consensus1, scores1, and scores2 must all be the same length"
+            )
+        self.consensus1 = consensus1
+        self.consensus2 = consensus2
+        self.is_consensus1 = is_consensus1
+        self.scores1 = scores1
+        self.scores2 = scores2
+
+    def is_dual(self) -> bool:
+        return self.consensus2 is not None
+
+    def __eq__(self, rhs) -> bool:
+        return (
+            isinstance(rhs, DualConsensus)
+            and self.consensus1 == rhs.consensus1
+            and self.consensus2 == rhs.consensus2
+            and self.is_consensus1 == rhs.is_consensus1
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"DualConsensus(consensus1={self.consensus1!r}, "
+            f"consensus2={self.consensus2!r}, is_consensus1={self.is_consensus1})"
+        )
+
+
+class _DualNode:
+    """Search node holding one (non-dual) or two consensus branches."""
+
+    __slots__ = (
+        "is_dual",
+        "lock1",
+        "lock2",
+        "consensus1",
+        "consensus2",
+        "h1",
+        "h2",
+        "active1",
+        "active2",
+        "offsets1",
+        "offsets2",
+        "stats1",
+        "stats2",
+    )
+
+    def __init__(self):
+        self.is_dual = False
+        self.lock1 = False
+        self.lock2 = False
+        self.consensus1 = b""
+        self.consensus2 = b""
+        self.h1 = None
+        self.h2 = None
+        self.active1: List[bool] = []
+        self.active2: List[bool] = []
+        self.offsets1: List[Optional[int]] = []
+        self.offsets2: List[Optional[int]] = []
+        self.stats1 = None
+        self.stats2 = None
+
+    # -- identity ------------------------------------------------------
+    def key(self) -> Tuple:
+        return (
+            self.is_dual,
+            self.lock1,
+            self.lock2,
+            self.consensus1,
+            self.consensus2,
+            tuple(o if a else None for a, o in zip(self.active1, self.offsets1)),
+            tuple(o if a else None for a, o in zip(self.active2, self.offsets2)),
+        )
+
+    def max_consensus_length(self) -> int:
+        return max(len(self.consensus1), len(self.consensus2))
+
+    # -- scoring -------------------------------------------------------
+    def best_costs(self, cost: ConsensusCost) -> Tuple[List[int], List[int]]:
+        """Per read, the best (index, score) over the tracked sides; ties
+        go to side 0; untracked reads report index ``-1`` / score 0."""
+        n = len(self.active1)
+        indices = [-1] * n
+        scores = [0] * n
+        for r in range(n):
+            best_score = None
+            best_index = -1
+            if self.active1[r]:
+                best_score = cost.apply(int(self.stats1.eds[r]))
+                best_index = 0
+            if self.is_dual and self.active2[r]:
+                s2 = cost.apply(int(self.stats2.eds[r]))
+                if best_score is None or s2 < best_score:
+                    best_score = s2
+                    best_index = 1
+            if best_score is not None:
+                indices[r] = best_index
+                scores[r] = best_score
+        return indices, scores
+
+    def total_cost(self, cost: ConsensusCost) -> int:
+        _, scores = self.best_costs(cost)
+        return sum(scores)
+
+    def priority(self, cost: ConsensusCost) -> Tuple[int, int]:
+        return (-self.total_cost(cost), self.max_consensus_length())
+
+    # -- predicates ------------------------------------------------------
+    def is_dual_imbalanced(self, min_count: int) -> bool:
+        if not self.is_dual:
+            return False
+        return sum(self.active1) < min_count or sum(self.active2) < min_count
+
+    def reached_all_end(self, require_all: bool) -> bool:
+        flags = []
+        for r in range(len(self.active1)):
+            p1 = self.active1[r] and bool(self.stats1.reached[r])
+            p2 = (
+                self.is_dual
+                and self.active2[r]
+                and bool(self.stats2.reached[r])
+            )
+            flags.append(p1 or p2)
+        return all(flags) if require_all else any(flags)
+
+    def reached_consensus_end(self, side1: bool, require_all: bool) -> bool:
+        if not side1 and not self.is_dual:
+            return False
+        active = self.active1 if side1 else self.active2
+        stats = self.stats1 if side1 else self.stats2
+        flags = [
+            bool(stats.reached[r]) if active[r] else require_all
+            for r in range(len(active))
+        ]
+        return all(flags) if require_all else any(flags)
+
+    # -- votes -----------------------------------------------------------
+    def ed_weights(self, side1: bool, weight_by_ed: bool) -> List[float]:
+        """Per-read vote weights from the relative edit distances of the
+        two tracked sides (``/root/reference/src/dual_consensus.rs:1299-1336``)."""
+        n = len(self.active1)
+        if not self.is_dual:
+            return [1.0] * n
+        min_ed = 0.5
+        equality_score = 0.5
+        out = []
+        for r in range(n):
+            c1 = max(float(self.stats1.eds[r]), min_ed) if self.active1[r] else None
+            c2 = max(float(self.stats2.eds[r]), min_ed) if self.active2[r] else None
+            if c1 is not None and c2 is not None:
+                if weight_by_ed:
+                    numer = c2 if side1 else c1
+                    out.append(numer / (c1 + c2))
+                elif c1 == c2:
+                    out.append(equality_score)
+                elif (side1 and c1 < c2) or (not side1 and c2 < c1):
+                    out.append(1.0)
+                else:
+                    out.append(0.0)
+            elif (c1 is not None and side1) or (c2 is not None and not side1):
+                out.append(1.0)
+            else:
+                out.append(0.0)
+        return out
+
+    def candidates(
+        self, side1: bool, symtab, wildcard, weighted_by_ed: bool
+    ) -> Dict[int, float]:
+        active = self.active1 if side1 else self.active2
+        stats = self.stats1 if side1 else self.stats2
+        if weighted_by_ed:
+            weights = self.ed_weights(side1, True)
+        else:
+            weights = [1.0] * len(active)
+        # mask untracked reads: their stats rows may be stale
+        weights = [w if a else 0.0 for w, a in zip(weights, active)]
+        return candidates_from_stats(stats, symtab, wildcard, weights)
+
+
+class DualConsensusDWFA:
+    """Generates the best single- or dual-consensus for the added reads.
+
+    Example::
+
+        from waffle_con_tpu import DualConsensusDWFA
+
+        engine = DualConsensusDWFA()
+        for s in reads:
+            engine.add_sequence(s)
+        results = engine.consensus()
+    """
+
+    def __init__(self, config: Optional[CdwfaConfig] = None) -> None:
+        self.config = config if config is not None else CdwfaConfig()
+        self.sequences: List[bytes] = []
+        self.offsets: List[Optional[int]] = []
+        self.alphabet: set = set()
+
+    @classmethod
+    def with_config(cls, config: CdwfaConfig) -> "DualConsensusDWFA":
+        return cls(config)
+
+    def add_sequence(self, sequence: bytes) -> None:
+        self.add_sequence_offset(sequence, None)
+
+    def add_sequence_offset(
+        self, sequence: bytes, last_offset: Optional[int]
+    ) -> None:
+        sequence = bytes(sequence)
+        self.alphabet.update(sequence)
+        if self.config.wildcard is not None:
+            self.alphabet.discard(self.config.wildcard)
+        self.sequences.append(sequence)
+        self.offsets.append(last_offset)
+
+    @property
+    def consensus_cost(self) -> ConsensusCost:
+        return self.config.consensus_cost
+
+    # ==================================================================
+
+    def consensus(self) -> List[DualConsensus]:
+        """Run the search; returns every tied-best result (sorted), or a
+        single empty-consensus fallback when no candidate survives
+        (parity skeleton: ``/root/reference/src/dual_consensus.rs:240-787``).
+        """
+        cfg = self.config
+        cost = cfg.consensus_cost
+        n_seqs = len(self.sequences)
+        maximum_error = math.inf
+        farthest_single = 0
+        farthest_dual = 0
+        single_last_constraint = 0
+        dual_last_constraint = 0
+        nodes_explored = 0
+        nodes_ignored = 0
+
+        offsets = shift_offsets(self.offsets, cfg.auto_shift_offsets)
+        logger.debug("Offsets: %s", offsets)
+
+        activate_points: Dict[int, List[int]] = {}
+        initially_active = 0
+        for seq_index, offset in enumerate(offsets):
+            if offset is not None:
+                activate_length = offset + cfg.offset_compare_length
+                activate_points.setdefault(activate_length, []).append(seq_index)
+            else:
+                initially_active += 1
+        if initially_active == 0:
+            raise EngineError(
+                "Must have at least one initial offset of None to see the consensus."
+            )
+
+        scorer = make_scorer(self.sequences, cfg)
+        initial_size = max(len(s) for s in self.sequences)
+        single_tracker = PQueueTracker(initial_size, cfg.max_capacity_per_size)
+        dual_tracker = PQueueTracker(initial_size, cfg.max_capacity_per_size)
+        pqueue = SetPriorityQueue()
+
+        root = _DualNode()
+        root.active1 = [o is None for o in offsets]
+        root.active2 = [False] * n_seqs
+        root.offsets1 = [0 if a else None for a in root.active1]
+        root.offsets2 = [None] * n_seqs
+        root.h1 = scorer.root(np.array(root.active1, dtype=bool))
+        root.stats1 = scorer.stats(root.h1, b"")
+        single_tracker.insert(root.max_consensus_length())
+        pqueue.push(root.key(), root, root.priority(cost))
+
+        results: List[DualConsensus] = []
+
+        # dynamic minimum counts driven by how many reads are active
+        full_min_count = max(
+            cfg.min_count, math.ceil(cfg.min_af * n_seqs)
+        )
+        total_active_count = [initially_active]
+        active_min_count = [
+            max(cfg.min_count, math.ceil(cfg.min_af * initially_active))
+        ]
+
+        while not pqueue.is_empty():
+            while (
+                len(single_tracker) > cfg.max_queue_size
+                or single_last_constraint >= cfg.max_nodes_wo_constraint
+            ) and single_tracker.threshold() < farthest_single:
+                single_tracker.increment_threshold()
+                single_last_constraint = 0
+            while (
+                len(dual_tracker) > cfg.max_queue_size
+                or dual_last_constraint >= cfg.max_nodes_wo_constraint
+            ) and dual_tracker.threshold() < farthest_dual:
+                dual_tracker.increment_threshold()
+                dual_last_constraint = 0
+
+            node, priority = pqueue.pop()
+            top_cost = -priority[0]
+            top_len = node.max_consensus_length()
+
+            if node.is_dual:
+                dual_tracker.remove(top_len)
+                threshold_cutoff = dual_tracker.threshold()
+                at_capacity = dual_tracker.at_capacity(top_len)
+            else:
+                single_tracker.remove(top_len)
+                threshold_cutoff = single_tracker.threshold()
+                at_capacity = single_tracker.at_capacity(top_len)
+
+            assert top_len < len(active_min_count)
+            if (
+                top_cost > maximum_error
+                or top_len < threshold_cutoff
+                or at_capacity
+                or node.is_dual_imbalanced(active_min_count[top_len])
+            ):
+                nodes_ignored += 1
+                self._free_node(scorer, node)
+                continue
+
+            if node.is_dual:
+                farthest_dual = max(farthest_dual, top_len)
+                dual_last_constraint += 1
+                dual_tracker.process(top_len)
+            else:
+                farthest_single = max(farthest_single, top_len)
+                single_last_constraint += 1
+                single_tracker.process(top_len)
+            nodes_explored += 1
+
+            # -- completion check -------------------------------------
+            if node.reached_all_end(cfg.allow_early_termination):
+                fin_result, fin_total = self._finalize(scorer, node)
+                imbalanced = False
+                if node.is_dual:
+                    counts1 = sum(fin_result.is_consensus1)
+                    counts2 = len(fin_result.is_consensus1) - counts1
+                    # note is_consensus1 already reflects any swap; the
+                    # imbalance test is symmetric so that is irrelevant
+                    imbalanced = (
+                        counts1 < full_min_count or counts2 < full_min_count
+                    )
+                if not imbalanced:
+                    if fin_total < maximum_error:
+                        maximum_error = fin_total
+                        results.clear()
+                    if (
+                        fin_total <= maximum_error
+                        and len(results) < cfg.max_return_size
+                    ):
+                        results.append(fin_result)
+                else:
+                    logger.debug("Finalized node is imbalanced, ignoring.")
+
+            # -- maintain the dynamic active-count tables -------------
+            if len(active_min_count) == top_len + 1:
+                new_total = total_active_count[top_len] + len(
+                    activate_points.get(top_len, [])
+                )
+                total_active_count.append(new_total)
+                active_min_count.append(
+                    max(cfg.min_count, math.ceil(cfg.min_af * new_total))
+                )
+
+            # -- extension ---------------------------------------------
+            self._expand(
+                scorer,
+                node,
+                activate_points,
+                pqueue,
+                single_tracker,
+                dual_tracker,
+                cost,
+            )
+            self._free_node(scorer, node)
+
+            assert len(pqueue) == single_tracker.unfiltered_len() + dual_tracker.unfiltered_len()
+
+        assert len(single_tracker) == 0
+        assert len(dual_tracker) == 0
+
+        if len(results) > 1:
+            results.sort(
+                key=lambda dc: (
+                    dc.consensus1.sequence,
+                    dc.consensus2.sequence if dc.consensus2 is not None else b"",
+                )
+            )
+
+        if not results:
+            logger.warning(
+                "No consensus found that reached end, is there a gap between "
+                "input sequences?"
+            )
+            results.append(
+                DualConsensus(
+                    Consensus(b"", cost, [0] * n_seqs),
+                    None,
+                    [True] * n_seqs,
+                    [0] * n_seqs,
+                    [None] * n_seqs,
+                )
+            )
+
+        logger.debug("nodes_explored: %d", nodes_explored)
+        logger.debug("nodes_ignored: %d", nodes_ignored)
+        return results
+
+    # ==================================================================
+    # node helpers
+
+    def _free_node(self, scorer: WavefrontScorer, node: _DualNode) -> None:
+        if node.h1 is not None:
+            scorer.free(node.h1)
+        if node.h2 is not None:
+            scorer.free(node.h2)
+        node.h1 = node.h2 = None
+
+    def _clone_node(self, scorer: WavefrontScorer, node: _DualNode) -> _DualNode:
+        child = _DualNode()
+        child.is_dual = node.is_dual
+        child.lock1 = node.lock1
+        child.lock2 = node.lock2
+        child.consensus1 = node.consensus1
+        child.consensus2 = node.consensus2
+        child.h1 = scorer.clone(node.h1)
+        child.h2 = scorer.clone(node.h2) if node.h2 is not None else None
+        child.active1 = list(node.active1)
+        child.active2 = list(node.active2)
+        child.offsets1 = list(node.offsets1)
+        child.offsets2 = list(node.offsets2)
+        child.stats1 = node.stats1
+        child.stats2 = node.stats2
+        return child
+
+    def _push_side(self, scorer, node: _DualNode, symbol: int, side1: bool) -> None:
+        if side1:
+            if node.lock1:
+                raise EngineError("Consensus 1 is locked, cannot modify")
+            node.consensus1 = node.consensus1 + bytes([symbol])
+            node.stats1 = scorer.push(node.h1, node.consensus1)
+        else:
+            if node.lock2:
+                raise EngineError("Consensus 2 is locked, cannot modify")
+            node.consensus2 = node.consensus2 + bytes([symbol])
+            node.stats2 = scorer.push(node.h2, node.consensus2)
+
+    def _activate_dual(
+        self, scorer, node: _DualNode, symbol1: int, symbol2: int
+    ) -> None:
+        """Split a non-dual node in two, extending the copies with the two
+        competing symbols (``/root/reference/src/dual_consensus.rs:957-976``)."""
+        assert not node.is_dual
+        assert symbol1 != symbol2
+        node.is_dual = True
+        node.consensus2 = node.consensus1
+        node.h2 = scorer.clone(node.h1)
+        node.active2 = list(node.active1)
+        node.offsets2 = list(node.offsets1)
+        node.stats2 = node.stats1
+        self._push_side(scorer, node, symbol1, True)
+        self._push_side(scorer, node, symbol2, False)
+
+    def _activate_sequence(self, scorer, node: _DualNode, seq_index: int) -> None:
+        cfg = self.config
+        sides = [(True, node.consensus1)]
+        if node.is_dual:
+            sides.append((False, node.consensus2))
+        for side1, consensus in sides:
+            active = node.active1 if side1 else node.active2
+            assert not active[seq_index]
+            offset = find_activation_offset(
+                consensus,
+                self.sequences[seq_index],
+                cfg.offset_window,
+                cfg.offset_compare_length,
+                cfg.wildcard,
+            )
+            handle = node.h1 if side1 else node.h2
+            scorer.activate(handle, seq_index, offset, consensus)
+            active[seq_index] = True
+            if side1:
+                node.offsets1[seq_index] = offset
+            else:
+                node.offsets2[seq_index] = offset
+        node.stats1 = scorer.stats(node.h1, node.consensus1)
+        if node.is_dual:
+            node.stats2 = scorer.stats(node.h2, node.consensus2)
+
+    def _maybe_activate(
+        self, scorer, node: _DualNode, activate_points: Dict[int, List[int]]
+    ) -> None:
+        activate_list = activate_points.get(node.max_consensus_length())
+        if activate_list:
+            for seq_index in activate_list:
+                self._activate_sequence(scorer, node, seq_index)
+
+    def _prune_dwfa(self, scorer, node: _DualNode, ed_delta: int) -> None:
+        """Drop the clearly-worse wavefront of a read tracked on both sides
+        (``/root/reference/src/dual_consensus.rs:1030-1045``)."""
+        if not node.is_dual:
+            return
+        for r in range(len(node.active1)):
+            if node.active1[r] and node.active2[r]:
+                e1 = int(node.stats1.eds[r])
+                e2 = int(node.stats2.eds[r])
+                if e1 + ed_delta < e2:
+                    scorer.deactivate(node.h2, r)
+                    node.active2[r] = False
+                    node.offsets2[r] = None
+                elif e2 + ed_delta < e1:
+                    scorer.deactivate(node.h1, r)
+                    node.active1[r] = False
+                    node.offsets1[r] = None
+
+    def _finalize(
+        self, scorer, node: _DualNode
+    ) -> Tuple[DualConsensus, int]:
+        """Finalize a scratch copy of the node, returning the result and its
+        total cost; raises when some read was never tracked anywhere."""
+        cost = self.config.consensus_cost
+        n = len(self.sequences)
+        for r in range(n):
+            if not node.active1[r] and not (node.is_dual and node.active2[r]):
+                raise EngineError(
+                    "Finalize called on DWFA that was never initialized."
+                )
+        fin1 = scorer.finalized_eds(node.h1, node.consensus1)
+        fin2 = (
+            scorer.finalized_eds(node.h2, node.consensus2)
+            if node.is_dual
+            else np.zeros(n, dtype=np.int64)
+        )
+
+        # per-read best side from finalized scores (ties -> side 1)
+        indices = []
+        best_scores = []
+        for r in range(n):
+            s1 = cost.apply(int(fin1[r])) if node.active1[r] else None
+            s2 = (
+                cost.apply(int(fin2[r]))
+                if node.is_dual and node.active2[r]
+                else None
+            )
+            if s1 is not None and (s2 is None or s1 <= s2):
+                indices.append(0)
+                best_scores.append(s1)
+            else:
+                indices.append(1)
+                best_scores.append(s2)
+
+        swap = node.is_dual and node.consensus2 < node.consensus1
+        is_consensus1 = [(idx == 0) ^ swap for idx in indices]
+        grouped_scores: List[List[int]] = [[], []]
+        for idx, score in zip(indices, best_scores):
+            grouped_scores[idx].append(score)
+
+        c1 = Consensus(node.consensus1, cost, grouped_scores[0])
+        c2 = Consensus(node.consensus2, cost, grouped_scores[1])
+        full1 = [
+            cost.apply(int(fin1[r])) if node.active1[r] else None for r in range(n)
+        ]
+        full2 = [
+            cost.apply(int(fin2[r])) if node.is_dual and node.active2[r] else None
+            for r in range(n)
+        ]
+        if swap:
+            result = DualConsensus(c2, c1, is_consensus1, full2, full1)
+        else:
+            result = DualConsensus(
+                c1, c2 if node.is_dual else None, is_consensus1, full1, full2
+            )
+        return result, sum(best_scores)
+
+    # ==================================================================
+    # expansion
+
+    def _queue_child(
+        self, pqueue, tracker, scorer, child: _DualNode, cost
+    ) -> None:
+        tracker.insert(child.max_consensus_length())
+        if not pqueue.push(child.key(), child, child.priority(cost)):
+            logger.warning("duplicate dual search node")
+            tracker.remove(child.max_consensus_length())
+            self._free_node(scorer, child)
+
+    def _expand(
+        self,
+        scorer,
+        node: _DualNode,
+        activate_points,
+        pqueue,
+        single_tracker,
+        dual_tracker,
+        cost,
+    ) -> None:
+        cfg = self.config
+        wildcard = cfg.wildcard
+        weighted = cfg.weighted_by_ed
+
+        ec1 = node.candidates(True, scorer.symtab, wildcard, weighted)
+        min_count1 = max(
+            cfg.min_count, math.ceil(cfg.min_af * sum(ec1.values()))
+        )
+        max_observed1 = max(ec1.values(), default=float(min_count1))
+        active_threshold1 = min(float(min_count1), max_observed1)
+
+        if node.is_dual:
+            ec2 = node.candidates(False, scorer.symtab, wildcard, weighted)
+            min_count2 = max(
+                cfg.min_count, math.ceil(cfg.min_af * sum(ec2.values()))
+            )
+            max_observed2 = max(ec2.values(), default=float(min_count2))
+            active_threshold2 = min(float(min_count2), max_observed2)
+
+            is_con1_finalized = node.reached_consensus_end(
+                True, cfg.allow_early_termination
+            )
+            is_con2_finalized = node.reached_consensus_end(
+                False, cfg.allow_early_termination
+            )
+
+            opt_ec1: List[Optional[int]] = []
+            if is_con1_finalized or not ec1 or node.lock1:
+                opt_ec1.append(None)
+            if not node.lock1:
+                opt_ec1.extend(
+                    sym
+                    for sym in sorted(ec1)
+                    if ec1[sym] >= active_threshold1
+                )
+
+            opt_ec2: List[Optional[int]] = []
+            if is_con2_finalized or not ec2 or node.lock2:
+                opt_ec2.append(None)
+            if not node.lock2:
+                opt_ec2.extend(
+                    sym
+                    for sym in sorted(ec2)
+                    if ec2[sym] >= active_threshold2
+                )
+
+            assert opt_ec1 and opt_ec2
+
+            for can1 in opt_ec1:
+                for can2 in opt_ec2:
+                    if can1 is None and can2 is None:
+                        continue  # extending neither would duplicate the node
+                    child = self._clone_node(scorer, node)
+                    if can1 is not None:
+                        self._push_side(scorer, child, can1, True)
+                    else:
+                        child.lock1 = True
+                    if can2 is not None:
+                        self._push_side(scorer, child, can2, False)
+                    else:
+                        child.lock2 = True
+                    self._maybe_activate(scorer, child, activate_points)
+                    self._prune_dwfa(scorer, child, cfg.dual_max_ed_delta)
+                    assert child.is_dual
+                    self._queue_child(pqueue, dual_tracker, scorer, child, cost)
+        else:
+            # stay non-dual: one child per passing symbol
+            for sym in sorted(ec1):
+                if ec1[sym] < active_threshold1:
+                    continue
+                child = self._clone_node(scorer, node)
+                self._push_side(scorer, child, sym, True)
+                self._maybe_activate(scorer, child, activate_points)
+                assert not child.is_dual
+                self._queue_child(pqueue, single_tracker, scorer, child, cost)
+
+            # dual-split generation: every unordered pair of distinct
+            # non-wildcard candidates, when at least two meet min_count1
+            sorted_candidates = sorted(
+                ((-count, sym) for sym, count in ec1.items() if sym != wildcard)
+            )
+            num_passing = sum(
+                1 for negc, _sym in sorted_candidates if -negc >= min_count1
+            )
+            if num_passing > 1:
+                for i, (_nc1, c1) in enumerate(sorted_candidates):
+                    for _nc2, c2 in sorted_candidates[i + 1 :]:
+                        child = self._clone_node(scorer, node)
+                        self._activate_dual(scorer, child, c1, c2)
+                        self._maybe_activate(scorer, child, activate_points)
+                        self._prune_dwfa(scorer, child, cfg.dual_max_ed_delta)
+                        assert child.is_dual
+                        self._queue_child(pqueue, dual_tracker, scorer, child, cost)

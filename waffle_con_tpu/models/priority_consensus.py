@@ -1,0 +1,196 @@
+"""Priority / multi consensus via recursive dual splits.
+
+Each input read is a *chain* of sequences (e.g. ``[hpc_compressed,
+full_length]``).  A worklist of read groups is repeatedly solved with the
+dual engine at the group's current chain level: dual results partition the
+group (same level), single results fix that level's consensus and advance
+the chain — a binary splitting tree whose leaves are the final consensus
+chains.  Capability parity with
+``/root/reference/src/priority_consensus.rs:63-341``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Set, Tuple
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.models.consensus import Consensus, EngineError
+from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+
+logger = logging.getLogger(__name__)
+
+
+class PriorityConsensus:
+    """Final result: one consensus chain per discovered group, plus the
+    group index each input read was assigned to."""
+
+    __slots__ = ("consensuses", "sequence_indices")
+
+    def __init__(
+        self,
+        consensuses: List[List[Consensus]],
+        sequence_indices: List[int],
+    ) -> None:
+        self.consensuses = consensuses
+        self.sequence_indices = sequence_indices
+
+    def __eq__(self, rhs) -> bool:
+        return (
+            isinstance(rhs, PriorityConsensus)
+            and self.consensuses == rhs.consensuses
+            and self.sequence_indices == rhs.sequence_indices
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"PriorityConsensus(consensuses={self.consensuses!r}, "
+            f"sequence_indices={self.sequence_indices})"
+        )
+
+
+class PriorityConsensusDWFA:
+    """Multi-consensus generation by iterated dual splitting over sequence
+    chains.
+
+    Example::
+
+        engine = PriorityConsensusDWFA()
+        for chain in chains:            # chain: [seq_level0, seq_level1, ...]
+            engine.add_sequence_chain(chain)
+        result = engine.consensus()
+    """
+
+    def __init__(self, config: Optional[CdwfaConfig] = None) -> None:
+        self.config = config if config is not None else CdwfaConfig()
+        self.sequences: List[List[bytes]] = []
+        self.offsets: List[List[Optional[int]]] = []
+        self.seed_groups: List[Optional[int]] = []
+        self.alphabet: set = set()
+
+    @classmethod
+    def with_config(cls, config: CdwfaConfig) -> "PriorityConsensusDWFA":
+        return cls(config)
+
+    def add_sequence_chain(self, sequences: List[bytes]) -> None:
+        self.add_seeded_sequence_chain(
+            sequences, [None] * len(sequences), None
+        )
+
+    def add_seeded_sequence_chain(
+        self,
+        sequences: List[bytes],
+        offsets: List[Optional[int]],
+        seed_group: Optional[int],
+    ) -> None:
+        if not sequences:
+            raise EngineError("Must provide a non-empty sequences Vec")
+        if self.sequences and len(self.sequences[0]) != len(sequences):
+            raise EngineError(
+                f"Expected sequences Vec of length {len(self.sequences[0])}, "
+                f"but got one of length {len(sequences)}"
+            )
+        sequences = [bytes(s) for s in sequences]
+        for sequence in sequences:
+            self.alphabet.update(sequence)
+        if self.config.wildcard is not None:
+            self.alphabet.discard(self.config.wildcard)
+        self.sequences.append(sequences)
+        self.offsets.append(list(offsets))
+        self.seed_groups.append(seed_group)
+
+    @property
+    def consensus_cost(self) -> ConsensusCost:
+        return self.config.consensus_cost
+
+    # ------------------------------------------------------------------
+
+    def consensus(self) -> PriorityConsensus:
+        max_split_level = len(self.sequences[0])
+        to_split: List[List[bool]] = []
+        split_levels: List[int] = []
+        consensus_chains: List[List[Consensus]] = []
+
+        # one initial group per distinct seed (deterministic order)
+        initial_group_keys: Set[Optional[int]] = set(self.seed_groups)
+        for igk in sorted(initial_group_keys, key=lambda k: (k is not None, k)):
+            to_split.append([sg == igk for sg in self.seed_groups])
+            split_levels.append(0)
+            consensus_chains.append([])
+
+        consensuses: List[List[Consensus]] = []
+        assignments: List[List[bool]] = []
+        while to_split:
+            include_set = to_split.pop()
+            current_split_level = split_levels.pop()
+            current_chain = consensus_chains.pop()
+
+            dc_dwfa = DualConsensusDWFA(self.config)
+            logger.debug(
+                "Calling Dual at level %d with: %s", current_split_level, include_set
+            )
+            for include, (seq_chain, offset_chain) in zip(
+                include_set, zip(self.sequences, self.offsets)
+            ):
+                if include:
+                    dc_dwfa.add_sequence_offset(
+                        seq_chain[current_split_level],
+                        offset_chain[current_split_level],
+                    )
+
+            dc_result = dc_dwfa.consensus()
+            if len(dc_result) > 1:
+                logger.debug(
+                    "Multiple dual consensuses detected, arbitrarily selecting "
+                    "first option."
+                )
+            chosen = dc_result[0]
+
+            if chosen.is_dual():
+                # partition the group by assignment; both halves re-split at
+                # the same chain level
+                is_c1 = chosen.is_consensus1
+                assign1 = [False] * len(self.sequences)
+                assign2 = [False] * len(self.sequences)
+                ic_index = 0
+                for i, included in enumerate(include_set):
+                    if included:
+                        if is_c1[ic_index]:
+                            assign1[i] = True
+                        else:
+                            assign2[i] = True
+                        ic_index += 1
+                assert ic_index == len(is_c1)
+
+                to_split.append(assign1)
+                split_levels.append(current_split_level)
+                consensus_chains.append(list(current_chain))
+                to_split.append(assign2)
+                split_levels.append(current_split_level)
+                consensus_chains.append(current_chain)
+            else:
+                new_split_level = current_split_level + 1
+                current_chain.append(chosen.consensus1)
+                if new_split_level == max_split_level:
+                    consensuses.append(current_chain)
+                    assignments.append(include_set)
+                else:
+                    to_split.append(include_set)
+                    split_levels.append(new_split_level)
+                    consensus_chains.append(current_chain)
+
+        if len(consensuses) > 1:
+            indices = [-1] * len(self.sequences)
+            order = sorted(
+                range(len(consensuses)),
+                key=lambda i: [c.sequence for c in consensuses[i]],
+            )
+            sorted_cons = []
+            for con_index, old_index in enumerate(order):
+                for i, assigned in enumerate(assignments[old_index]):
+                    if assigned:
+                        assert indices[i] == -1
+                        indices[i] = con_index
+                sorted_cons.append(consensuses[old_index])
+            return PriorityConsensus(sorted_cons, indices)
+        return PriorityConsensus(consensuses, [0] * len(self.sequences))

@@ -1,0 +1,7 @@
+"""Alignment kernels: incremental dynamic-WFA, one-shot WFA, and the
+batched JAX/TPU scorer."""
+
+from waffle_con_tpu.ops.alignment import wfa_ed, wfa_ed_config
+from waffle_con_tpu.ops.dwfa import DWFALite
+
+__all__ = ["DWFALite", "wfa_ed", "wfa_ed_config"]

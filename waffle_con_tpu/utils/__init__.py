@@ -1,0 +1,5 @@
+"""Host-side utilities: queue tracking, synthetic data, fixtures."""
+
+from waffle_con_tpu.utils.pqueue import CapacityFullError, PQueueTracker, SetPriorityQueue
+
+__all__ = ["CapacityFullError", "PQueueTracker", "SetPriorityQueue"]

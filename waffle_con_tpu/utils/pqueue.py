@@ -1,0 +1,148 @@
+"""Search-queue infrastructure for the consensus engines.
+
+Two pieces:
+
+* :class:`PQueueTracker` — beam/threshold accounting sidecar (capability
+  parity with ``/root/reference/src/pqueue_tracker.rs:10-144``): histogram
+  of queued consensus lengths above a rising threshold, plus per-length
+  processed-node capacities.
+* :class:`SetPriorityQueue` — a max-priority queue with *set semantics*
+  (one entry per key), replacing the reference's ``priority-queue`` crate:
+  the engines rely on pushes of an already-present node being detectable
+  (``/root/reference/src/dual_consensus.rs:648,678,731`` asserts they never
+  happen).  Ties on priority pop in FIFO order, which is deterministic.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+
+class CapacityFullError(Exception):
+    """Raised by :meth:`PQueueTracker.process` when a length is at capacity."""
+
+
+class PQueueTracker:
+    """Tracks how many queued items of each consensus length remain above a
+    monotonically rising length threshold, and how many items of each
+    length have been processed (with a per-length capacity)."""
+
+    def __init__(self, initial_size: int, capacity_per_size: int) -> None:
+        self._length_counts: List[int] = [0] * initial_size
+        self._total_count = 0
+        self._threshold = 0
+        self._processed_counts: List[int] = [0] * initial_size
+        self._capacity_per_size = capacity_per_size
+
+    def insert(self, value: int) -> None:
+        if value >= len(self._length_counts):
+            self._length_counts.extend([0] * (value + 1 - len(self._length_counts)))
+        self._length_counts[value] += 1
+        if value >= self._threshold:
+            self._total_count += 1
+
+    def remove(self, value: int) -> None:
+        assert self._length_counts[value] > 0
+        self._length_counts[value] -= 1
+        if value >= self._threshold:
+            assert self._total_count > 0
+            self._total_count -= 1
+
+    def increment_threshold(self) -> None:
+        self.increase_threshold(self._threshold + 1)
+
+    def increase_threshold(self, new_threshold: int) -> None:
+        assert new_threshold >= self._threshold
+        for t in range(self._threshold, new_threshold):
+            if t < len(self._length_counts):
+                self._total_count -= self._length_counts[t]
+        self._threshold = new_threshold
+
+    def process(self, value: int) -> None:
+        """Mark one item of this length processed; error when full."""
+        if value >= len(self._processed_counts):
+            self._processed_counts.extend(
+                [0] * (value + 1 - len(self._processed_counts))
+            )
+        if self._processed_counts[value] >= self._capacity_per_size:
+            raise CapacityFullError("Capacity is full")
+        self._processed_counts[value] += 1
+
+    def processed(self, value: int) -> int:
+        if value >= len(self._processed_counts):
+            return 0
+        return self._processed_counts[value]
+
+    def at_capacity(self, value: int) -> bool:
+        return self.processed(value) >= self._capacity_per_size
+
+    def __len__(self) -> int:
+        return self._total_count
+
+    def unfiltered_len(self) -> int:
+        return sum(self._length_counts)
+
+    def is_empty(self) -> bool:
+        return self._total_count == 0
+
+    def threshold(self) -> int:
+        return self._threshold
+
+    def occupancy(self, value: int) -> int:
+        if value >= len(self._length_counts):
+            return 0
+        return self._length_counts[value]
+
+
+class SetPriorityQueue:
+    """Max-priority queue keyed by hashable identity.
+
+    ``push`` returns ``False`` (and leaves the queue unchanged apart from
+    updating the stored payload/priority) when the key is already present —
+    the engines assert this never fires, mirroring the reference's
+    duplicate-node invariant.  Pop order: highest priority first; equal
+    priorities pop in insertion order.
+    """
+
+    def __init__(self) -> None:
+        # heap entries: (neg_priority_tuple, seq, key)
+        self._heap: List[Tuple[Any, int, Hashable]] = []
+        self._live: Dict[Hashable, Tuple[Any, Any]] = {}  # key -> (priority, item)
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+    def is_empty(self) -> bool:
+        return not self._live
+
+    def push(self, key: Hashable, item: Any, priority: Tuple) -> bool:
+        """Insert ``item`` with ``priority`` (a tuple where larger wins).
+
+        Returns True if the key was new.  When the key is already present
+        the queue is left untouched and False is returned, so the caller
+        still owns (and must dispose of) the rejected item.
+        """
+        if key in self._live:
+            return False
+        self._live[key] = (priority, item)
+        heapq.heappush(self._heap, (self._negate(priority), self._seq, key))
+        self._seq += 1
+        return True
+
+    def pop(self) -> Tuple[Any, Any]:
+        """Remove and return ``(item, priority)`` of the best entry."""
+        while self._heap:
+            _neg, _seq, key = heapq.heappop(self._heap)
+            entry = self._live.get(key)
+            if entry is None:
+                continue  # stale (already popped)
+            priority, item = entry
+            del self._live[key]
+            return item, priority
+        raise IndexError("pop from empty SetPriorityQueue")
+
+    @staticmethod
+    def _negate(priority: Tuple) -> Tuple:
+        return tuple(-p for p in priority)
