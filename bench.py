@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""North-star benchmark: single-consensus wall clock, TPU engine vs the
+native C++ CPU engine (the reference-equivalent baseline; the reference
+publishes no numbers — BASELINE.md).
+
+Default config: 256 reads × 10 kb at 1% error (HiFi-like), alphabet 4,
+min_count = reads/4 — the BASELINE.json north-star point.  Smoke mode
+(``BENCH_SMOKE=1``) shrinks to 16×1000 for quick validation.
+
+Prints exactly one JSON line:
+``{"metric": ..., "value": <tpu seconds>, "unit": "s",
+   "vs_baseline": <cpu_time / tpu_time>, ...}``
+so ``vs_baseline`` > 1 is a speedup over the CPU baseline.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def run() -> None:
+    from waffle_con_tpu import CdwfaConfigBuilder, ConsensusDWFA
+    from waffle_con_tpu.native import native_consensus
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    num_reads = 16 if smoke else 256
+    seq_len = 1000 if smoke else 10_000
+    error_rate = 0.01
+    min_count = max(2, num_reads // 4)
+
+    gen_start = time.perf_counter()
+    truth, reads = generate_test(4, seq_len, num_reads, error_rate, seed=0)
+    gen_time = time.perf_counter() - gen_start
+
+    cfg = lambda backend: (  # noqa: E731
+        CdwfaConfigBuilder().min_count(min_count).backend(backend).build()
+    )
+
+    # CPU baseline: complete C++ engine
+    cpu_start = time.perf_counter()
+    cpu_results = native_consensus(reads, config=cfg("native"))
+    cpu_time = time.perf_counter() - cpu_start
+
+    # TPU engine: warm-up once (compile), then timed run
+    def tpu_run():
+        engine = ConsensusDWFA(cfg("jax"))
+        for r in reads:
+            engine.add_sequence(r)
+        return engine.consensus()
+
+    tpu_results = tpu_run()  # warm-up / compile
+    tpu_start = time.perf_counter()
+    tpu_results = tpu_run()
+    tpu_time = time.perf_counter() - tpu_start
+
+    parity = [
+        (c.sequence, c.scores) for c in tpu_results
+    ] == cpu_results
+    recovered = tpu_results[0].sequence == truth if tpu_results else False
+
+    print(
+        json.dumps(
+            {
+                "metric": f"consensus_{num_reads}x{seq_len}_wall_s",
+                "value": round(tpu_time, 4),
+                "unit": "s",
+                "vs_baseline": round(cpu_time / tpu_time, 3),
+                "cpu_baseline_s": round(cpu_time, 4),
+                "parity": bool(parity),
+                "recovered_truth": bool(recovered),
+                "gen_s": round(gen_time, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    run()

@@ -1,12 +1,20 @@
 """Test-session setup: force JAX onto the host CPU backend with 8 virtual
 devices so multi-chip sharding paths compile and execute without TPUs.
-Must run before anything imports jax."""
+
+Note: this environment registers a TPU PJRT plugin from sitecustomize and
+pins ``JAX_PLATFORMS`` in the ambient env, so plain env-var overrides are
+ineffective — ``jax.config.update`` before first backend use is the
+reliable switch (XLA_FLAGS is still read at backend init, so setting it
+here works as long as no array op ran yet)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,200 @@
+"""Exact-parity tests: the batched JAX scorer must agree with the pure
+Python oracle on every observable (integer edit distances, tip votes,
+reached flags) and, through the engines, produce byte-identical
+consensus results."""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.ops.scorer import PythonScorer
+from waffle_con_tpu.utils.example_gen import generate_test
+from waffle_con_tpu.utils.fixtures import load_dual_fixture
+
+
+def assert_stats_equal(py, jx, context=""):
+    np.testing.assert_array_equal(py.eds, jx.eds, err_msg=f"eds {context}")
+    np.testing.assert_array_equal(py.occ, jx.occ, err_msg=f"occ {context}")
+    np.testing.assert_array_equal(py.split, jx.split, err_msg=f"split {context}")
+    np.testing.assert_array_equal(
+        py.reached, jx.reached, err_msg=f"reached {context}"
+    )
+
+
+def mirrored_scorers(reads, **cfg):
+    config = CdwfaConfig(**cfg)
+    return PythonScorer(reads, config), JaxScorer(reads, config)
+
+
+def test_push_parity_random_walk():
+    rng = np.random.default_rng(3)
+    reads = [bytes(rng.integers(0, 4, size=rng.integers(10, 40))) for _ in range(6)]
+    py, jx = mirrored_scorers(reads)
+    hp = py.root(np.ones(6, dtype=bool))
+    hj = jx.root(np.ones(6, dtype=bool))
+    assert_stats_equal(py.stats(hp, b""), jx.stats(hj, b""), "root")
+
+    # walk: follow the plurality vote with occasional random symbols, which
+    # forces edit-distance escalations
+    consensus = b""
+    for step in range(18):
+        sp = py.stats(hp, consensus)
+        if step % 5 == 4:
+            sym = int(rng.integers(0, 4))
+        else:
+            votes = sp.occ.sum(axis=0)
+            sym = int(py.symtab[int(np.argmax(votes))])
+        consensus += bytes([sym])
+        assert_stats_equal(
+            py.push(hp, consensus), jx.push(hj, consensus), f"step {step}"
+        )
+
+    np.testing.assert_array_equal(
+        py.finalized_eds(hp, consensus), jx.finalized_eds(hj, consensus)
+    )
+
+
+def test_clone_and_deactivate_parity():
+    rng = np.random.default_rng(4)
+    reads = [bytes(rng.integers(0, 4, size=20)) for _ in range(4)]
+    py, jx = mirrored_scorers(reads)
+    hp = py.root(np.ones(4, dtype=bool))
+    hj = jx.root(np.ones(4, dtype=bool))
+    consensus = reads[0][:5]
+    for i in range(1, len(consensus) + 1):
+        py.push(hp, consensus[:i])
+        jx.push(hj, consensus[:i])
+    hp2 = py.clone(hp)
+    hj2 = jx.clone(hj)
+    py.deactivate(hp2, 1)
+    jx.deactivate(hj2, 1)
+    ext = consensus + bytes([reads[0][5]])
+    assert_stats_equal(py.push(hp2, ext), jx.push(hj2, ext), "clone+deact")
+    # the original branch is untouched by the clone's evolution
+    assert_stats_equal(py.stats(hp, consensus), jx.stats(hj, consensus), "orig")
+    py.free(hp2)
+    jx.free(hj2)
+    assert_stats_equal(py.stats(hp, consensus), jx.stats(hj, consensus), "freed")
+
+
+def test_activation_parity():
+    rng = np.random.default_rng(5)
+    base = bytes(rng.integers(0, 4, size=24))
+    reads = [base, base, base[12:]]
+    py, jx = mirrored_scorers(reads, offset_window=5, offset_compare_length=8)
+    active = np.array([True, True, False])
+    hp = py.root(active)
+    hj = jx.root(active)
+    consensus = b""
+    for i in range(18):
+        consensus += bytes([base[i]])
+        sp = py.push(hp, consensus)
+        sj = jx.push(hj, consensus)
+        assert_stats_equal(sp, sj, f"pre-activate {i}")
+    py.activate(hp, 2, 12, consensus)
+    jx.activate(hj, 2, 12, consensus)
+    assert_stats_equal(
+        py.stats(hp, consensus), jx.stats(hj, consensus), "post-activate"
+    )
+    for i in range(18, 24):
+        consensus += bytes([base[i]])
+        assert_stats_equal(
+            py.push(hp, consensus), jx.push(hj, consensus), f"post-activate {i}"
+        )
+    np.testing.assert_array_equal(
+        py.finalized_eds(hp, consensus), jx.finalized_eds(hj, consensus)
+    )
+
+
+def test_wavefront_rebucketing():
+    # a read wildly different from the consensus forces e far beyond the
+    # initial bucket (E=8), exercising overflow + re-bucket + retry
+    reads = [b"\x00" * 24, b"\x01" * 24]
+    py, jx = mirrored_scorers(reads)
+    hp = py.root(np.ones(2, dtype=bool))
+    hj = jx.root(np.ones(2, dtype=bool))
+    consensus = b""
+    for i in range(24):
+        consensus += b"\x00"
+        assert_stats_equal(
+            py.push(hp, consensus), jx.push(hj, consensus), f"step {i}"
+        )
+    assert jx._E > JaxScorer.INITIAL_E
+    np.testing.assert_array_equal(
+        py.finalized_eds(hp, consensus), jx.finalized_eds(hj, consensus)
+    )
+
+
+def test_wildcard_parity():
+    reads = [b"\x00\x01\x09\x03" * 4, b"\x00\x01\x02\x03" * 4]
+    py, jx = mirrored_scorers(reads, wildcard=9)
+    hp = py.root(np.ones(2, dtype=bool))
+    hj = jx.root(np.ones(2, dtype=bool))
+    consensus = b""
+    for sym in b"\x00\x01\x02\x03" * 4:
+        consensus += bytes([sym])
+        assert_stats_equal(py.push(hp, consensus), jx.push(hj, consensus))
+
+
+def test_single_engine_backend_parity():
+    truth, reads = generate_test(4, 40, 6, 0.02, seed=17)
+    results = {}
+    for backend in ("python", "jax"):
+        engine = ConsensusDWFA(
+            CdwfaConfigBuilder().backend(backend).build()
+        )
+        for r in reads:
+            engine.add_sequence(r)
+        results[backend] = engine.consensus()
+    assert results["python"] == results["jax"]
+    assert results["jax"][0].sequence == truth
+
+
+def test_dual_engine_backend_parity_small():
+    # small two-haplotype split: exercises dual splitting, pruning, and
+    # result swapping through the JAX scorer at test-friendly size
+    sequences = [b"ACGTACGT", b"ACGTACGT", b"AGGTACGT", b"AGGTACGT"]
+    results = {}
+    for backend in ("python", "jax"):
+        engine = DualConsensusDWFA(
+            CdwfaConfigBuilder().min_count(1).backend(backend).build()
+        )
+        for s in sequences:
+            engine.add_sequence(s)
+        results[backend] = engine.consensus()
+    assert results["python"] == results["jax"]
+    assert results["jax"][0].is_dual()
+    for a, b in zip(results["python"], results["jax"]):
+        assert a.scores1 == b.scores1
+        assert a.scores2 == b.scores2
+        assert a.consensus1.scores == b.consensus1.scores
+
+
+@pytest.mark.slow
+def test_dual_engine_backend_parity_fixture():
+    from waffle_con_tpu import ConsensusCost
+
+    sequences, expected = load_dual_fixture(
+        "dual_001", True, ConsensusCost.L1_DISTANCE
+    )
+    results = {}
+    for backend in ("python", "jax"):
+        engine = DualConsensusDWFA(
+            CdwfaConfigBuilder().wildcard(ord("*")).backend(backend).build()
+        )
+        for s in sequences:
+            engine.add_sequence(s)
+        results[backend] = engine.consensus()
+    assert results["python"] == results["jax"]
+    assert results["jax"] == [expected]
+    # scores are ignored by equality; compare them explicitly
+    for a, b in zip(results["python"], results["jax"]):
+        assert a.scores1 == b.scores1
+        assert a.scores2 == b.scores2
+        assert a.consensus1.scores == b.consensus1.scores

@@ -1,0 +1,123 @@
+"""Parity tests for the native (C++) scorer and engine against the
+Python oracle."""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+    ConsensusCost,
+)
+from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.native import (
+    NativeScorer,
+    native_consensus,
+    native_wfa_ed,
+)
+from waffle_con_tpu.ops.alignment import wfa_ed_config
+from waffle_con_tpu.ops.scorer import PythonScorer
+from waffle_con_tpu.utils.example_gen import generate_test
+from waffle_con_tpu.utils.fixtures import load_dual_fixture
+
+
+def test_native_wfa_ed_parity():
+    rng = np.random.default_rng(21)
+    for _ in range(30):
+        a = bytes(rng.integers(0, 4, size=rng.integers(0, 40)))
+        b = bytes(rng.integers(0, 4, size=rng.integers(0, 40)))
+        for both in (True, False):
+            assert native_wfa_ed(a, b, both, None) == wfa_ed_config(
+                a, b, both, None
+            )
+
+
+def test_native_scorer_walk_parity():
+    rng = np.random.default_rng(22)
+    reads = [bytes(rng.integers(0, 4, size=rng.integers(10, 40))) for _ in range(6)]
+    config = CdwfaConfig()
+    py = PythonScorer(reads, config)
+    nt = NativeScorer(reads, config)
+    hp = py.root(np.ones(6, dtype=bool))
+    hn = nt.root(np.ones(6, dtype=bool))
+    consensus = b""
+    for step in range(30):
+        sp = py.stats(hp, consensus)
+        if step % 5 == 4:
+            sym = int(rng.integers(0, 4))
+        else:
+            sym = int(py.symtab[int(np.argmax(sp.occ.sum(axis=0)))])
+        consensus += bytes([sym])
+        a = py.push(hp, consensus)
+        b = nt.push(hn, consensus)
+        np.testing.assert_array_equal(a.eds, b.eds)
+        np.testing.assert_array_equal(a.occ, b.occ)
+        np.testing.assert_array_equal(a.split, b.split)
+        np.testing.assert_array_equal(a.reached, b.reached)
+    np.testing.assert_array_equal(
+        py.finalized_eds(hp, consensus), nt.finalized_eds(hn, consensus)
+    )
+
+
+def test_native_backend_single_engine():
+    truth, reads = generate_test(4, 60, 8, 0.02, seed=17)
+    results = {}
+    for backend in ("python", "native"):
+        engine = ConsensusDWFA(CdwfaConfigBuilder().backend(backend).build())
+        for r in reads:
+            engine.add_sequence(r)
+        results[backend] = engine.consensus()
+    assert results["python"] == results["native"]
+
+
+def test_native_backend_dual_engine():
+    sequences, expected = load_dual_fixture(
+        "dual_001", True, ConsensusCost.L1_DISTANCE
+    )
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder().wildcard(ord("*")).backend("native").build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    assert engine.consensus() == [expected]
+
+
+def test_native_full_engine_parity():
+    # the complete C++ engine against the Python engine, including scores
+    truth, reads = generate_test(4, 80, 10, 0.02, seed=33)
+    engine = ConsensusDWFA()
+    for r in reads:
+        engine.add_sequence(r)
+    expected = engine.consensus()
+    got = native_consensus(reads)
+    assert [(c.sequence, c.scores) for c in expected] == got
+
+
+def test_native_full_engine_wildcards_and_l2():
+    sequences = [b"ACGTACCGT****", b"**GTATGTAC**", b"****ACGTACGT"]
+    for cost in (ConsensusCost.L1_DISTANCE, ConsensusCost.L2_DISTANCE):
+        cfg = (
+            CdwfaConfigBuilder()
+            .wildcard(ord("*"))
+            .consensus_cost(cost)
+            .build()
+        )
+        engine = ConsensusDWFA(cfg)
+        for s in sequences:
+            engine.add_sequence(s)
+        expected = engine.consensus()
+        got = native_consensus(sequences, config=cfg)
+        assert [(c.sequence, c.scores) for c in expected] == got
+
+
+def test_native_full_engine_offsets():
+    sequences = [b"ACGTACGTACGTACGT", b"ACGTACGTACGT", b"GTACGTACGT"]
+    offsets = [None, 4, 7]
+    cfg = CdwfaConfigBuilder().offset_window(1).offset_compare_length(4).build()
+    engine = ConsensusDWFA(cfg)
+    for s, o in zip(sequences, offsets):
+        engine.add_sequence_offset(s, o)
+    expected = engine.consensus()
+    got = native_consensus(sequences, offsets, cfg)
+    assert [(c.sequence, c.scores) for c in expected] == got

@@ -222,8 +222,9 @@ class ConsensusDWFA:
             )
 
         scorer = make_scorer(self.sequences, cfg)
+        self._max_sequence_len = max(len(s) for s in self.sequences)
         tracker = PQueueTracker(
-            max(len(s) for s in self.sequences), cfg.max_capacity_per_size
+            self._max_sequence_len, cfg.max_capacity_per_size
         )
         pqueue = SetPriorityQueue()
 
@@ -264,6 +265,81 @@ class ConsensusDWFA:
                 nodes_ignored += 1
                 scorer.free(node.handle)
                 continue
+
+            # -- device fast path: when this node is the whole frontier, let
+            # the scorer extend it through unambiguous stretches on device
+            # (one host round-trip per event instead of per base), then
+            # replay the per-length bookkeeping exactly.
+            run_extend = getattr(scorer, "run_extend", None)
+            run_budget = -1
+            if run_extend is not None and top_len >= farthest_consensus:
+                # the run may continue while this node stays the strict
+                # pop-winner: its cost below every other queued node's
+                # (conservative on cost ties) and below the best result.
+                # Requiring top_len >= farthest keeps the replayed steps
+                # ahead of any threshold constriction (threshold always
+                # stays < farthest == the chain length), so the
+                # below-threshold prune can never fire on them.
+                best_other = pqueue.peek_priority()
+                run_budget = maximum_error
+                if best_other is not None:
+                    run_budget = min(run_budget, -best_other[0] - 1)
+            if run_extend is not None and run_budget >= top_cost:
+                next_act = min(
+                    (l for l in activate_points if l > top_len), default=None
+                )
+                cap_stop = next(
+                    (
+                        l
+                        for l in range(top_len + 1, farthest_consensus + 1)
+                        if tracker.at_capacity(l)
+                    ),
+                    None,
+                )
+                max_steps = self._max_sequence_len * 2 + 256
+                if next_act is not None:
+                    max_steps = min(max_steps, next_act - top_len - 1)
+                if cap_stop is not None:
+                    max_steps = min(max_steps, cap_stop - top_len)
+                if max_steps >= 1:
+                    budget = (
+                        int(run_budget)
+                        if run_budget != math.inf
+                        else 2**31 - 1
+                    )
+                    steps, _code, appended = run_extend(
+                        node.handle,
+                        node.consensus,
+                        budget,
+                        cfg.min_count,
+                        cost is ConsensusCost.L2_DISTANCE,
+                        max_steps,
+                    )
+                    if steps > 0:
+                        for j in range(steps):
+                            length = top_len + j
+                            if j > 0:
+                                while (
+                                    len(tracker) > cfg.max_queue_size
+                                    or last_constraint
+                                    >= cfg.max_nodes_wo_constraint
+                                ) and tracker.threshold() < farthest_consensus:
+                                    tracker.increment_threshold()
+                                    last_constraint = 0
+                                tracker.remove(length)
+                            farthest_consensus = max(farthest_consensus, length)
+                            nodes_explored += 1
+                            last_constraint += 1
+                            tracker.process(length)
+                            tracker.insert(length + 1)
+                        node.consensus = node.consensus + appended
+                        node.stats = scorer.stats(node.handle, node.consensus)
+                        if not pqueue.push(
+                            node.key(), node, node.priority(cost)
+                        ):  # pragma: no cover - chain nodes are unique
+                            tracker.remove(len(node.consensus))
+                            scorer.free(node.handle)
+                        continue
 
             farthest_consensus = max(farthest_consensus, top_len)
             nodes_explored += 1

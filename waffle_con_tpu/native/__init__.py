@@ -1,0 +1,284 @@
+"""ctypes bindings for the native (C++) kernels and engine.
+
+Builds ``src/waffle_native.cpp`` with g++ on first use (cached shared
+object next to the sources).  Provides:
+
+* :class:`NativeScorer` — the C++ implementation of the
+  :class:`~waffle_con_tpu.ops.scorer.WavefrontScorer` seam
+  (``backend="native"``);
+* :func:`native_consensus` — the complete C++ single-consensus engine,
+  used as the CPU baseline by ``bench.py``;
+* :func:`native_wfa_ed` — one-shot edit distance.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import struct
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_SRC = _HERE / "src" / "waffle_native.cpp"
+_LIB = _HERE / "_libwaffle.so"
+_LOCK = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+_I64 = ctypes.c_longlong
+_I64P = ctypes.POINTER(_I64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        str(_SRC),
+        "-o",
+        str(_LIB),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{proc.stderr[-4000:]}"
+        )
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _LOCK:
+        if _lib is not None:
+            return _lib
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            _build()
+        lib = ctypes.CDLL(str(_LIB))
+
+        lib.wn_scorer_new.restype = ctypes.c_void_p
+        lib.wn_scorer_new.argtypes = [
+            _U8P, _I64P, _I64, _U8P, _I64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.wn_scorer_free.argtypes = [ctypes.c_void_p]
+        lib.wn_root.restype = _I64
+        lib.wn_root.argtypes = [ctypes.c_void_p, _U8P]
+        lib.wn_clone.restype = _I64
+        lib.wn_clone.argtypes = [ctypes.c_void_p, _I64]
+        lib.wn_free_branch.argtypes = [ctypes.c_void_p, _I64]
+        lib.wn_push.argtypes = [
+            ctypes.c_void_p, _I64, _U8P, _I64, _I64P, _I64P, _I64P, _U8P,
+        ]
+        lib.wn_stats.argtypes = lib.wn_push.argtypes
+        lib.wn_activate.argtypes = [
+            ctypes.c_void_p, _I64, _I64, _I64, _U8P, _I64,
+        ]
+        lib.wn_deactivate.argtypes = [ctypes.c_void_p, _I64, _I64]
+        lib.wn_finalized_eds.argtypes = [
+            ctypes.c_void_p, _I64, _U8P, _I64, _I64P,
+        ]
+        lib.wn_wfa_ed.restype = _I64
+        lib.wn_wfa_ed.argtypes = [
+            _U8P, _I64, _U8P, _I64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.wn_consensus.restype = ctypes.c_int
+        lib.wn_consensus.argtypes = [
+            _U8P, _I64P, _I64, _I64P, _I64P, ctypes.c_double,
+            ctypes.POINTER(_U8P), _I64P,
+        ]
+        lib.wn_blob_free.argtypes = [_U8P]
+        _lib = lib
+        return lib
+
+
+def _bytes_ptr(data: bytes):
+    return ctypes.cast(ctypes.create_string_buffer(data, len(data)), _U8P)
+
+
+def _pack_reads(reads: Sequence[bytes]):
+    blob = b"".join(reads)
+    lens = np.array([len(r) for r in reads], dtype=np.int64)
+    return (
+        _bytes_ptr(blob),
+        lens.ctypes.data_as(_I64P),
+        lens,  # keep alive
+    )
+
+
+class NativeScorer(WavefrontScorer):
+    """C++ branch store behind the scorer seam."""
+
+    def __init__(self, reads: Sequence[bytes], config: CdwfaConfig) -> None:
+        super().__init__(reads, config)
+        self._lib = load_library()
+        data_ptr, lens_ptr, self._keep = _pack_reads(self.reads)
+        symtab = np.asarray(self.symtab, dtype=np.uint8)
+        self._ptr = self._lib.wn_scorer_new(
+            data_ptr,
+            lens_ptr,
+            len(self.reads),
+            symtab.ctypes.data_as(_U8P),
+            len(symtab),
+            -1 if config.wildcard is None else config.wildcard,
+            1 if config.allow_early_termination else 0,
+        )
+
+    def __del__(self):
+        try:
+            if getattr(self, "_ptr", None):
+                self._lib.wn_scorer_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+    def _out_buffers(self):
+        n, a = self.num_reads, self.num_symbols
+        eds = np.zeros(n, dtype=np.int64)
+        occ = np.zeros((n, a), dtype=np.int64)
+        split = np.zeros(n, dtype=np.int64)
+        reached = np.zeros(n, dtype=np.uint8)
+        return eds, occ, split, reached
+
+    def root(self, active: np.ndarray) -> int:
+        act = np.ascontiguousarray(active, dtype=np.uint8)
+        return self._lib.wn_root(self._ptr, act.ctypes.data_as(_U8P))
+
+    def clone(self, h: int) -> int:
+        return self._lib.wn_clone(self._ptr, h)
+
+    def free(self, h: int) -> None:
+        self._lib.wn_free_branch(self._ptr, h)
+
+    def push(self, h: int, consensus: bytes) -> BranchStats:
+        eds, occ, split, reached = self._out_buffers()
+        self._lib.wn_push(
+            self._ptr, h, _bytes_ptr(consensus), len(consensus),
+            eds.ctypes.data_as(_I64P), occ.ctypes.data_as(_I64P),
+            split.ctypes.data_as(_I64P), reached.ctypes.data_as(_U8P),
+        )
+        return BranchStats(eds, occ, split, reached.astype(bool))
+
+    def stats(self, h: int, consensus: bytes) -> BranchStats:
+        eds, occ, split, reached = self._out_buffers()
+        self._lib.wn_stats(
+            self._ptr, h, _bytes_ptr(consensus), len(consensus),
+            eds.ctypes.data_as(_I64P), occ.ctypes.data_as(_I64P),
+            split.ctypes.data_as(_I64P), reached.ctypes.data_as(_U8P),
+        )
+        return BranchStats(eds, occ, split, reached.astype(bool))
+
+    def activate(self, h: int, read_index: int, offset: int, consensus: bytes) -> None:
+        self._lib.wn_activate(
+            self._ptr, h, read_index, offset, _bytes_ptr(consensus), len(consensus)
+        )
+
+    def deactivate(self, h: int, read_index: int) -> None:
+        self._lib.wn_deactivate(self._ptr, h, read_index)
+
+    def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
+        eds = np.zeros(self.num_reads, dtype=np.int64)
+        self._lib.wn_finalized_eds(
+            self._ptr, h, _bytes_ptr(consensus), len(consensus),
+            eds.ctypes.data_as(_I64P),
+        )
+        return eds
+
+
+def native_wfa_ed(
+    v1: bytes, v2: bytes, require_both_end: bool = True,
+    wildcard: Optional[int] = None,
+) -> int:
+    lib = load_library()
+    return lib.wn_wfa_ed(
+        _bytes_ptr(v1), len(v1), _bytes_ptr(v2), len(v2),
+        1 if require_both_end else 0,
+        -1 if wildcard is None else wildcard,
+    )
+
+
+_ENGINE_ERRORS = {
+    1: "Must have at least one initial offset of None to see the consensus.",
+    3: "Finalize called on DWFA that was never initialized.",
+}
+
+
+def native_consensus(
+    reads: Sequence[bytes],
+    offsets: Optional[Sequence[Optional[int]]] = None,
+    config: Optional[CdwfaConfig] = None,
+) -> List[Tuple[bytes, List[int]]]:
+    """Run the full C++ single-consensus engine; returns
+    ``[(sequence, scores), ...]`` sorted lexicographically."""
+    from waffle_con_tpu.models.consensus import EngineError
+
+    cfg = config if config is not None else CdwfaConfig()
+    if offsets is None:
+        offsets = [None] * len(reads)
+    lib = load_library()
+    data_ptr, lens_ptr, _keep = _pack_reads([bytes(r) for r in reads])
+    offs = np.array(
+        [-1 if o is None else o for o in offsets], dtype=np.int64
+    )
+    int_cfg = np.array(
+        [
+            1 if cfg.consensus_cost is ConsensusCost.L2_DISTANCE else 0,
+            cfg.max_queue_size,
+            cfg.max_capacity_per_size,
+            cfg.max_return_size,
+            cfg.max_nodes_wo_constraint,
+            cfg.min_count,
+            -1 if cfg.wildcard is None else cfg.wildcard,
+            1 if cfg.allow_early_termination else 0,
+            1 if cfg.auto_shift_offsets else 0,
+            cfg.offset_window,
+            cfg.offset_compare_length,
+        ],
+        dtype=np.int64,
+    )
+    blob = _U8P()
+    size = _I64(0)
+    rc = lib.wn_consensus(
+        data_ptr, lens_ptr, len(reads), offs.ctypes.data_as(_I64P),
+        int_cfg.ctypes.data_as(_I64P), cfg.min_af,
+        ctypes.byref(blob), ctypes.byref(size),
+    )
+    if rc != 0:
+        if rc == 2:
+            raise EngineError("Encountered coverage gap")
+        raise EngineError(_ENGINE_ERRORS.get(rc, f"native engine error {rc}"))
+    try:
+        raw = ctypes.string_at(blob, size.value)
+    finally:
+        lib.wn_blob_free(blob)
+
+    results = []
+    pos = 0
+
+    def read_i64():
+        nonlocal pos
+        (v,) = struct.unpack_from("<q", raw, pos)
+        pos += 8
+        return v
+
+    n_results = read_i64()
+    for _ in range(n_results):
+        seq_len = read_i64()
+        sequence = raw[pos : pos + seq_len]
+        pos += seq_len
+        n_scores = read_i64()
+        scores = [read_i64() for _ in range(n_scores)]
+        results.append((sequence, scores))
+    return results

@@ -1,0 +1,605 @@
+// Native (C++) kernels and engine for waffle_con_tpu.
+//
+// Provides the serial-CPU implementation of the framework's two layers:
+//   1. the incremental dynamic-WFA kernel + a WavefrontScorer-compatible
+//      branch store (exact behavioral parity with ops/dwfa.py — the
+//      executable spec — and transitively with the reference
+//      /root/reference/src/dynamic_wfa.rs);
+//   2. a complete single-consensus search engine (parity with
+//      models/consensus.py, i.e. /root/reference/src/consensus.rs) used
+//      as the CPU baseline in bench.py.
+//
+// Wavefronts use centered diagonal coordinates: diagonal k = (other
+// consumed) - (baseline consumed) ranges over [-e, +e]; the stored value
+// is bases consumed in `other` beyond `offset`; the baseline position of
+// a lane is d - k.
+//
+// Exposed as a C ABI for ctypes (see ../__init__.py).
+
+#include <algorithm>
+#include <array>
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <map>
+#include <memory>
+#include <optional>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using std::size_t;
+using i64 = long long;
+using Bytes = std::vector<uint8_t>;
+
+// ---------------------------------------------------------------------
+// L0: incremental dynamic WFA (parity: ops/dwfa.py::DWFALite)
+
+struct DWFA {
+  i64 e = 0;
+  std::vector<i64> wf{0};  // index i <-> diagonal k = i - e
+  i64 offset = 0;
+
+  void extend(const Bytes& baseline, const Bytes& other, int wildcard) {
+    const i64 blen = (i64)baseline.size();
+    const i64 olen = (i64)other.size();
+    for (size_t i = 0; i < wf.size(); ++i) {
+      i64 d = wf[i];
+      const i64 k = (i64)i - e;
+      i64 bo = d - k;
+      i64 oo = d + offset;
+      while (bo < blen && oo < olen) {
+        const int b = baseline[(size_t)bo];
+        if (b != other[(size_t)oo] && b != wildcard) break;
+        ++d; ++bo; ++oo;
+      }
+      wf[i] = d;
+    }
+  }
+
+  void escalate(const Bytes& baseline, const Bytes& other, int wildcard) {
+    const size_t n = wf.size();
+    ++e;
+    std::vector<i64> nw(n + 2, 0);
+    for (size_t i = 0; i < n; ++i) {
+      const i64 d = wf[i];
+      nw[i] = std::max(nw[i], d);          // baseline deletion
+      nw[i + 1] = std::max(nw[i + 1], d + 1);  // mismatch
+      nw[i + 2] = std::max(nw[i + 2], d + 1);  // insertion into baseline
+    }
+    wf.swap(nw);
+    extend(baseline, other, wildcard);
+  }
+
+  i64 max_other() const {
+    i64 m = std::numeric_limits<i64>::min();
+    for (i64 d : wf) m = std::max(m, d);
+    return offset + m;
+  }
+
+  i64 max_baseline() const {
+    i64 m = std::numeric_limits<i64>::min();
+    for (size_t i = 0; i < wf.size(); ++i) m = std::max(m, wf[i] - ((i64)i - e));
+    return m;
+  }
+
+  bool reached_end(const Bytes& baseline) const {
+    return max_baseline() == (i64)baseline.size();
+  }
+
+  void update(const Bytes& baseline, const Bytes& other, int wildcard,
+              bool early_term) {
+    extend(baseline, other, wildcard);
+    const i64 target = (i64)other.size();
+    while (max_other() < target && !(early_term && reached_end(baseline))) {
+      escalate(baseline, other, wildcard);
+    }
+  }
+
+  void finalize(const Bytes& baseline, const Bytes& other, int wildcard) {
+    const i64 blen = (i64)baseline.size();
+    while (max_baseline() < blen) escalate(baseline, other, wildcard);
+  }
+
+  // tip votes for the next consensus symbol: lanes that consumed all of
+  // `other`, voting the baseline char they face
+  void tips(const Bytes& baseline, const Bytes& other,
+            std::map<int, i64>& votes) const {
+    const i64 olen = (i64)other.size();
+    const i64 blen = (i64)baseline.size();
+    for (size_t i = 0; i < wf.size(); ++i) {
+      const i64 d = wf[i];
+      if (d + offset == olen) {
+        const i64 bo = d - ((i64)i - e);
+        if (bo < blen) votes[baseline[(size_t)bo]] += 1;
+      }
+    }
+  }
+};
+
+// one-shot WFA edit distance (parity: ops/alignment.py::wfa_ed_config)
+i64 wfa_ed_config(const uint8_t* v1, i64 l1, const uint8_t* v2, i64 l2,
+                  bool require_both_end, int wildcard) {
+  std::vector<std::pair<i64, i64>> curr{{0, 0}};
+  i64 edits = 0;
+  for (;;) {
+    std::vector<std::pair<i64, i64>> next(2 * edits + 3, {0, 0});
+    for (size_t w = 0; w < curr.size(); ++w) {
+      i64 i = curr[w].first, j = curr[w].second;
+      while (i < l1 && j < l2 &&
+             (v1[i] == v2[j] || v1[i] == wildcard || v2[j] == wildcard)) {
+        ++i; ++j;
+      }
+      if (j == l2 && (i == l1 || !require_both_end)) return edits;
+      std::pair<i64, i64> a, b, c;
+      if (i == l1) {
+        a = {i, j}; b = {i, j + 1}; c = {i, j + 1};
+      } else if (j == l2) {
+        a = {i + 1, j}; b = {i + 1, j}; c = {i, j};
+      } else {
+        a = {i + 1, j}; b = {i + 1, j + 1}; c = {i, j + 1};
+      }
+      next[w] = std::max(next[w], a);
+      next[w + 1] = std::max(next[w + 1], b);
+      next[w + 2] = std::max(next[w + 2], c);
+    }
+    ++edits;
+    curr.swap(next);
+  }
+}
+
+// ---------------------------------------------------------------------
+// scorer branch store (parity: ops/scorer.py::PythonScorer)
+
+struct Scorer {
+  std::vector<Bytes> reads;
+  std::vector<int> symtab;              // dense id -> byte
+  std::array<int, 256> sym_id;          // byte -> dense id (or -1)
+  int wildcard = -1;                    // byte value or -1
+  bool early_term = false;
+  std::unordered_map<i64, std::vector<std::optional<DWFA>>> branches;
+  i64 next_handle = 0;
+
+  size_t R() const { return reads.size(); }
+  size_t A() const { return symtab.size(); }
+};
+
+void scorer_snapshot(Scorer& s, const std::vector<std::optional<DWFA>>& dwfas,
+                     const Bytes& cons, i64* eds, i64* occ, i64* split,
+                     uint8_t* reached) {
+  const size_t R = s.R(), A = s.A();
+  std::fill(eds, eds + R, 0);
+  std::fill(occ, occ + R * A, 0);
+  std::fill(split, split + R, 0);
+  std::fill(reached, reached + R, 0);
+  std::map<int, i64> votes;
+  for (size_t r = 0; r < R; ++r) {
+    if (!dwfas[r]) continue;
+    const DWFA& dw = *dwfas[r];
+    eds[r] = dw.e;
+    reached[r] = dw.reached_end(s.reads[r]) ? 1 : 0;
+    votes.clear();
+    dw.tips(s.reads[r], cons, votes);
+    i64 total = 0;
+    for (auto& [sym, count] : votes) {
+      occ[r * A + s.sym_id[sym]] = count;
+      total += count;
+    }
+    split[r] = total;
+  }
+}
+
+// ---------------------------------------------------------------------
+// single-consensus engine (parity: models/consensus.py::ConsensusDWFA)
+
+struct EngineConfig {
+  int cost_l2 = 0;                 // 0 = L1, 1 = L2
+  i64 max_queue_size = 20;
+  i64 max_capacity_per_size = 20;
+  i64 max_return_size = 10;
+  i64 max_nodes_wo_constraint = 1000;
+  i64 min_count = 3;
+  double min_af = 0.0;
+  int wildcard = -1;
+  int allow_early_termination = 0;
+  int auto_shift_offsets = 1;
+  i64 offset_window = 50;
+  i64 offset_compare_length = 50;
+};
+
+struct Tracker {
+  std::vector<i64> length_counts, processed_counts;
+  i64 total = 0, thr = 0, cap = 0;
+  explicit Tracker(size_t n, i64 capacity) : length_counts(n, 0), processed_counts(n, 0), cap(capacity) {}
+  void ensure(std::vector<i64>& v, size_t n) { if (v.size() <= n) v.resize(n + 1, 0); }
+  void insert(i64 v) { ensure(length_counts, v); length_counts[v]++; if (v >= thr) total++; }
+  void remove(i64 v) { length_counts[v]--; if (v >= thr) total--; }
+  void inc_threshold() { if ((size_t)thr < length_counts.size()) total -= length_counts[thr]; thr++; }
+  bool process(i64 v) { ensure(processed_counts, v); if (processed_counts[v] >= cap) return false; processed_counts[v]++; return true; }
+  bool at_capacity(i64 v) const {
+    return (size_t)v < processed_counts.size() && processed_counts[v] >= cap;
+  }
+};
+
+struct Node {
+  Bytes consensus;
+  std::vector<std::optional<DWFA>> dwfas;
+  i64 cost = 0;
+
+  i64 total_cost(bool l2) const {
+    i64 t = 0;
+    for (auto& d : dwfas)
+      if (d) t += l2 ? d->e * d->e : d->e;
+    return t;
+  }
+};
+
+struct Result {
+  Bytes sequence;
+  std::vector<i64> scores;
+};
+
+i64 activation_offset(const Bytes& cons, const Bytes& seq, const EngineConfig& cfg) {
+  const i64 cmp = std::min<i64>(cfg.offset_compare_length, (i64)seq.size());
+  const i64 clen = (i64)cons.size();
+  const i64 start = std::max<i64>(0, clen - (cfg.offset_window + cmp));
+  const i64 end = std::max<i64>(0, clen - cmp);
+  i64 best = std::max<i64>(0, clen - (cmp + cfg.offset_window / 2));
+  i64 best_ed = wfa_ed_config(cons.data() + best, clen - best, seq.data(), cmp,
+                              false, cfg.wildcard);
+  for (i64 p = start; p < end; ++p) {
+    i64 ed = wfa_ed_config(cons.data() + p, clen - p, seq.data(), cmp, false,
+                           cfg.wildcard);
+    if (ed < best_ed) { best_ed = ed; best = p; }
+  }
+  return best;
+}
+
+// error codes
+constexpr int ERR_OK = 0;
+constexpr int ERR_NO_INITIAL = 1;       // no initially active sequence
+constexpr int ERR_COVERAGE_GAP = 2;     // coverage gap before activation
+constexpr int ERR_UNINITIALIZED = 3;    // finalize on inactive DWFA
+
+int run_consensus(const std::vector<Bytes>& reads,
+                  const std::vector<i64>& in_offsets,  // -1 = none
+                  const EngineConfig& cfg, std::vector<Result>& out) {
+  const size_t R = reads.size();
+  const bool l2 = cfg.cost_l2 != 0;
+  const bool et = cfg.allow_early_termination != 0;
+
+  std::vector<i64> offsets(in_offsets);
+  if (cfg.auto_shift_offsets) {
+    i64 mn = std::numeric_limits<i64>::max();
+    bool have_start = false;
+    for (i64 o : offsets) {
+      if (o < 0) have_start = true; else mn = std::min(mn, o);
+    }
+    if (!have_start) {
+      for (i64& o : offsets) o = (o == mn) ? -1 : o - mn;
+    }
+  }
+
+  std::map<i64, std::vector<size_t>> activate_points;
+  i64 max_activate = 0;
+  size_t initially_active = 0;
+  for (size_t i = 0; i < R; ++i) {
+    if (offsets[i] >= 0) {
+      i64 al = offsets[i] + cfg.offset_compare_length;
+      activate_points[al].push_back(i);
+      max_activate = std::max(max_activate, al);
+    } else {
+      ++initially_active;
+    }
+  }
+  if (initially_active == 0) return ERR_NO_INITIAL;
+
+  size_t max_len = 0;
+  for (auto& r : reads) max_len = std::max(max_len, r.size());
+  Tracker tracker(max_len, cfg.max_capacity_per_size);
+
+  // max-priority: lowest cost, then longest consensus, then FIFO
+  struct QKey {
+    i64 cost; i64 len; i64 seq;
+    bool operator<(const QKey& o) const {
+      if (cost != o.cost) return cost < o.cost;
+      if (len != o.len) return len > o.len;
+      return seq < o.seq;
+    }
+  };
+  std::map<QKey, std::unique_ptr<Node>> queue;
+  i64 seq_counter = 0;
+
+  auto root = std::make_unique<Node>();
+  root->dwfas.resize(R);
+  for (size_t i = 0; i < R; ++i)
+    if (offsets[i] < 0) root->dwfas[i].emplace();
+  root->cost = 0;
+  tracker.insert(0);
+  queue.emplace(QKey{0, 0, seq_counter++}, std::move(root));
+
+  i64 maximum_error = std::numeric_limits<i64>::max();
+  i64 farthest = 0, last_constraint = 0;
+  out.clear();
+
+  while (!queue.empty()) {
+    while ((tracker.total > cfg.max_queue_size ||
+            last_constraint >= cfg.max_nodes_wo_constraint) &&
+           tracker.thr < farthest) {
+      tracker.inc_threshold();
+      last_constraint = 0;
+    }
+
+    auto it = queue.begin();
+    std::unique_ptr<Node> node = std::move(it->second);
+    const i64 top_cost = it->first.cost;
+    queue.erase(it);
+    const i64 top_len = (i64)node->consensus.size();
+    tracker.remove(top_len);
+
+    if (top_cost > maximum_error || top_len < tracker.thr ||
+        tracker.at_capacity(top_len))
+      continue;
+
+    farthest = std::max(farthest, top_len);
+    ++last_constraint;
+    tracker.process(top_len);
+
+    // completion check
+    bool any_end = false, all_end = true;
+    for (size_t r = 0; r < R; ++r) {
+      const bool reached = node->dwfas[r] && node->dwfas[r]->reached_end(reads[r]);
+      any_end |= reached;
+      all_end &= reached;
+    }
+    if (et ? all_end : any_end) {
+      for (size_t r = 0; r < R; ++r)
+        if (!node->dwfas[r]) return ERR_UNINITIALIZED;
+      // finalize a scratch copy
+      std::vector<i64> fin(R);
+      i64 fin_total = 0;
+      for (size_t r = 0; r < R; ++r) {
+        DWFA scratch = *node->dwfas[r];
+        scratch.finalize(reads[r], node->consensus, cfg.wildcard);
+        fin[r] = l2 ? scratch.e * scratch.e : scratch.e;
+        fin_total += fin[r];
+      }
+      if (fin_total < maximum_error) {
+        maximum_error = fin_total;
+        out.clear();
+      }
+      if (fin_total <= maximum_error && (i64)out.size() < cfg.max_return_size) {
+        out.push_back(Result{node->consensus, fin});
+      }
+    }
+
+    // candidate nomination: fractional votes accumulated in read order
+    std::map<int, double> candidates;
+    std::map<int, i64> votes;
+    for (size_t r = 0; r < R; ++r) {
+      if (!node->dwfas[r]) continue;
+      votes.clear();
+      node->dwfas[r]->tips(reads[r], node->consensus, votes);
+      i64 total = 0;
+      for (auto& [sym, c] : votes) total += c;
+      if (total == 0) continue;
+      for (auto& [sym, c] : votes)
+        candidates[sym] += (double)c / (double)total;
+    }
+    if (cfg.wildcard >= 0 && candidates.size() > 1)
+      candidates.erase(cfg.wildcard);
+
+    double max_observed = (double)cfg.min_count;
+    if (!candidates.empty()) {
+      max_observed = -1.0;
+      for (auto& [sym, c] : candidates) max_observed = std::max(max_observed, c);
+    }
+    const double threshold = std::min((double)cfg.min_count, max_observed);
+
+    std::vector<int> passing;
+    for (auto& [sym, c] : candidates)
+      if (c >= threshold) passing.push_back(sym);
+
+    if (passing.empty()) {
+      if (top_len < max_activate) return ERR_COVERAGE_GAP;
+      continue;
+    }
+
+    for (size_t pi = 0; pi < passing.size(); ++pi) {
+      std::unique_ptr<Node> child;
+      if (pi + 1 == passing.size()) {
+        child = std::move(node);  // move-in-place for the last child
+      } else {
+        child = std::make_unique<Node>(*node);
+      }
+      child->consensus.push_back((uint8_t)passing[pi]);
+      for (size_t r = 0; r < R; ++r)
+        if (child->dwfas[r])
+          child->dwfas[r]->update(reads[r], child->consensus, cfg.wildcard, et);
+
+      auto ap = activate_points.find((i64)child->consensus.size());
+      if (ap != activate_points.end()) {
+        for (size_t r : ap->second) {
+          i64 off = activation_offset(child->consensus, reads[r], cfg);
+          DWFA dw;
+          dw.offset = off;
+          dw.update(reads[r], child->consensus, cfg.wildcard, et);
+          child->dwfas[r] = std::move(dw);
+        }
+      }
+      const i64 c_cost = child->total_cost(l2);
+      const i64 c_len = (i64)child->consensus.size();
+      tracker.insert(c_len);
+      queue.emplace(QKey{c_cost, c_len, seq_counter++}, std::move(child));
+    }
+  }
+
+  std::sort(out.begin(), out.end(),
+            [](const Result& a, const Result& b) { return a.sequence < b.sequence; });
+  return ERR_OK;
+}
+
+Scorer* as_scorer(void* p) { return reinterpret_cast<Scorer*>(p); }
+
+}  // namespace
+
+// ---------------------------------------------------------------------
+// C ABI
+
+extern "C" {
+
+void* wn_scorer_new(const uint8_t* read_data, const i64* read_lens, i64 n_reads,
+                    const uint8_t* symtab, i64 n_symbols, int wildcard,
+                    int early_term) {
+  auto* s = new Scorer();
+  i64 pos = 0;
+  for (i64 i = 0; i < n_reads; ++i) {
+    s->reads.emplace_back(read_data + pos, read_data + pos + read_lens[i]);
+    pos += read_lens[i];
+  }
+  s->sym_id.fill(-1);
+  for (i64 i = 0; i < n_symbols; ++i) {
+    s->symtab.push_back(symtab[i]);
+    s->sym_id[symtab[i]] = (int)i;
+  }
+  s->wildcard = wildcard;
+  s->early_term = early_term != 0;
+  return s;
+}
+
+void wn_scorer_free(void* p) { delete as_scorer(p); }
+
+i64 wn_root(void* p, const uint8_t* active) {
+  auto* s = as_scorer(p);
+  std::vector<std::optional<DWFA>> dwfas(s->R());
+  for (size_t r = 0; r < s->R(); ++r)
+    if (active[r]) dwfas[r].emplace();
+  const i64 h = s->next_handle++;
+  s->branches.emplace(h, std::move(dwfas));
+  return h;
+}
+
+i64 wn_clone(void* p, i64 h) {
+  auto* s = as_scorer(p);
+  const i64 nh = s->next_handle++;
+  s->branches.emplace(nh, s->branches.at(h));
+  return nh;
+}
+
+void wn_free_branch(void* p, i64 h) { as_scorer(p)->branches.erase(h); }
+
+void wn_push(void* p, i64 h, const uint8_t* cons, i64 clen, i64* eds, i64* occ,
+             i64* split, uint8_t* reached) {
+  auto* s = as_scorer(p);
+  auto& dwfas = s->branches.at(h);
+  Bytes consensus(cons, cons + clen);
+  for (size_t r = 0; r < s->R(); ++r)
+    if (dwfas[r])
+      dwfas[r]->update(s->reads[r], consensus, s->wildcard, s->early_term);
+  scorer_snapshot(*s, dwfas, consensus, eds, occ, split, reached);
+}
+
+void wn_stats(void* p, i64 h, const uint8_t* cons, i64 clen, i64* eds, i64* occ,
+              i64* split, uint8_t* reached) {
+  auto* s = as_scorer(p);
+  Bytes consensus(cons, cons + clen);
+  scorer_snapshot(*s, s->branches.at(h), consensus, eds, occ, split, reached);
+}
+
+void wn_activate(void* p, i64 h, i64 read_index, i64 offset, const uint8_t* cons,
+                 i64 clen) {
+  auto* s = as_scorer(p);
+  Bytes consensus(cons, cons + clen);
+  DWFA dw;
+  dw.offset = offset;
+  dw.update(s->reads[(size_t)read_index], consensus, s->wildcard, s->early_term);
+  s->branches.at(h)[(size_t)read_index] = std::move(dw);
+}
+
+void wn_deactivate(void* p, i64 h, i64 read_index) {
+  as_scorer(p)->branches.at(h)[(size_t)read_index].reset();
+}
+
+void wn_finalized_eds(void* p, i64 h, const uint8_t* cons, i64 clen, i64* eds) {
+  auto* s = as_scorer(p);
+  Bytes consensus(cons, cons + clen);
+  auto& dwfas = s->branches.at(h);
+  for (size_t r = 0; r < s->R(); ++r) {
+    if (dwfas[r]) {
+      DWFA scratch = *dwfas[r];
+      scratch.finalize(s->reads[r], consensus, s->wildcard);
+      eds[r] = scratch.e;
+    } else {
+      eds[r] = 0;
+    }
+  }
+}
+
+i64 wn_wfa_ed(const uint8_t* v1, i64 l1, const uint8_t* v2, i64 l2,
+              int require_both_end, int wildcard) {
+  return wfa_ed_config(v1, l1, v2, l2, require_both_end != 0, wildcard);
+}
+
+// Full single-consensus engine.  Returns an error code; on success the
+// result blob layout is:
+//   i64 n_results; then per result: i64 seq_len, bytes, i64 n_scores,
+//   i64 scores[]  (blob malloc'd; free with wn_blob_free)
+int wn_consensus(const uint8_t* read_data, const i64* read_lens, i64 n_reads,
+                 const i64* offsets,  // -1 = none
+                 const i64* int_cfg,  // [cost_l2, max_queue, max_cap, max_ret,
+                                      //  max_nodes, min_count, wildcard(-1),
+                                      //  early_term, auto_shift, off_window,
+                                      //  off_cmp_len]
+                 double min_af, uint8_t** out_blob, i64* out_size) {
+  std::vector<Bytes> reads;
+  i64 pos = 0;
+  for (i64 i = 0; i < n_reads; ++i) {
+    reads.emplace_back(read_data + pos, read_data + pos + read_lens[i]);
+    pos += read_lens[i];
+  }
+  EngineConfig cfg;
+  cfg.cost_l2 = (int)int_cfg[0];
+  cfg.max_queue_size = int_cfg[1];
+  cfg.max_capacity_per_size = int_cfg[2];
+  cfg.max_return_size = int_cfg[3];
+  cfg.max_nodes_wo_constraint = int_cfg[4];
+  cfg.min_count = int_cfg[5];
+  cfg.wildcard = (int)int_cfg[6];
+  cfg.allow_early_termination = (int)int_cfg[7];
+  cfg.auto_shift_offsets = (int)int_cfg[8];
+  cfg.offset_window = int_cfg[9];
+  cfg.offset_compare_length = int_cfg[10];
+  cfg.min_af = min_af;
+
+  std::vector<i64> offs(offsets, offsets + n_reads);
+  std::vector<Result> results;
+  int rc = run_consensus(reads, offs, cfg, results);
+  if (rc != ERR_OK) return rc;
+
+  i64 size = sizeof(i64);
+  for (auto& r : results)
+    size += sizeof(i64) * 2 + (i64)r.sequence.size() + sizeof(i64) * (i64)r.scores.size();
+  uint8_t* blob = (uint8_t*)malloc((size_t)size);
+  uint8_t* w = blob;
+  auto put_i64 = [&w](i64 v) { std::memcpy(w, &v, sizeof(i64)); w += sizeof(i64); };
+  put_i64((i64)results.size());
+  for (auto& r : results) {
+    put_i64((i64)r.sequence.size());
+    std::memcpy(w, r.sequence.data(), r.sequence.size());
+    w += r.sequence.size();
+    put_i64((i64)r.scores.size());
+    for (i64 v : r.scores) put_i64(v);
+  }
+  *out_blob = blob;
+  *out_size = size;
+  return ERR_OK;
+}
+
+void wn_blob_free(uint8_t* blob) { free(blob); }
+
+}  // extern "C"

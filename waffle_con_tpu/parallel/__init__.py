@@ -1,0 +1,10 @@
+"""Device-mesh parallelism: sharded wavefront steps with collective vote
+reduction."""
+
+from waffle_con_tpu.parallel.mesh import (
+    make_mesh,
+    sharded_branch_step,
+    sharded_consensus_step,
+)
+
+__all__ = ["make_mesh", "sharded_branch_step", "sharded_consensus_step"]

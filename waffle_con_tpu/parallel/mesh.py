@@ -1,0 +1,193 @@
+"""Mesh-parallel wavefront steps.
+
+The consensus framework has two embarrassingly-parallel axes (SURVEY.md
+§2, parallelism inventory): *reads* (every read's wavefront advances
+independently — the data-parallel axis) and *branches* (live search
+hypotheses — a model/batch-parallel axis).  This module maps them onto a
+``jax.sharding.Mesh``:
+
+* reads are sharded across chips; each chip advances its read shard's
+  wavefronts locally (pure VPU work, no communication);
+* the per-step candidate-vote histogram (``[A]`` integer counts), total
+  cost, and reached-end flags are reduced with ``lax.psum`` over the read
+  axis — small fixed-size collectives that ride ICI;
+* branches shard over a second mesh axis with no cross-branch
+  communication at all.
+
+This is the TPU-native equivalent of a distributed communication backend
+for this workload: the only cross-chip traffic the algorithm needs is the
+vote/cost reduction, identical in shape to a gradient ``psum`` in data-
+parallel training.  Multi-host DCN scaling uses the same program — a mesh
+spanning hosts simply makes the ``psum`` cross DCN.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from waffle_con_tpu.ops.jax_scorer import _stats_row, _update_row
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("read",),
+) -> Mesh:
+    """Build a mesh over the first ``n_devices`` (or all) devices.
+
+    ``shape`` reshapes the device list for multi-axis meshes, e.g.
+    ``shape=(2, 4), axis_names=("branch", "read")``.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    arr = np.array(devices)
+    if shape is not None:
+        arr = arr.reshape(tuple(shape))
+    else:
+        shape = (len(devices),)
+    if len(shape) != len(axis_names):
+        raise ValueError("shape and axis_names must have equal rank")
+    return Mesh(arr, tuple(axis_names))
+
+
+def sharded_consensus_step(mesh: Mesh, read_axis: str = "read", num_symbols: int = 32):
+    """Build a jitted data-parallel consensus step for one branch.
+
+    Returns ``step(d, e, off, act, cons, clen, reads, rlen, sym, wc, et)
+    -> (d', e', votes[num_symbols], ed_total, reached_any, overflow)`` where
+    the per-read state and the reads are sharded over ``read_axis`` and the
+    reductions are ``psum``-ed over it.  ``votes`` are the integer
+    one-tip-symbol read counts; ``ed_total`` is the raw edit-distance sum
+    (apply the L1/L2 cost model on the host).  Dense symbol ids must be
+    < ``num_symbols``.
+    """
+
+    def body(d, e, off, act, cons, clen, reads, rlen, sym, wc, et):
+        W = d.shape[1]
+        emax = jnp.int32(W // 2)
+        kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
+        C = cons.shape[0]
+
+        cons2 = cons.at[jnp.clip(clen, 0, C - 1)].set(sym)
+        clen2 = clen + 1
+        d2, e2, overflow = _update_row(
+            d, e, off, act, cons2, clen2, reads, rlen, wc, et, kvec, emax
+        )
+        eds, occ, _split, reached = _stats_row(
+            d2, e2, off, act, cons2, clen2, reads, rlen, num_symbols, kvec
+        )
+        votes = lax.psum((occ > 0).sum(axis=0), read_axis)
+        total = lax.psum(jnp.where(act, eds, 0).sum(), read_axis)
+        reached_any = lax.psum(reached.any().astype(jnp.int32), read_axis) > 0
+        overflow = lax.psum(overflow.astype(jnp.int32), read_axis) > 0
+        return d2, e2, votes, total, reached_any, overflow
+
+    spec_state = P(read_axis, None)
+    spec_read = P(read_axis)
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            spec_state,  # d
+            spec_read,  # e
+            spec_read,  # off
+            spec_read,  # act
+            P(None),  # cons
+            P(),  # clen
+            spec_state,  # reads
+            spec_read,  # rlen
+            P(),  # sym
+            P(),  # wc
+            P(),  # et
+        ),
+        out_specs=(
+            spec_state,
+            spec_read,
+            P(None),
+            P(),
+            P(),
+            P(),
+        ),
+    )
+    return jax.jit(sharded)
+
+
+def sharded_branch_step(mesh: Mesh, branch_axis: str = "branch", read_axis: str = "read", num_symbols: int = 32):
+    """Build the 2D-mesh step: branches × reads.
+
+    State carries a leading branch dimension (``d [B, R, W]`` etc.) and a
+    per-branch consensus/symbol; branches shard over ``branch_axis``
+    (independent, zero communication) while each branch's votes/costs
+    reduce over ``read_axis``.  This is the full multi-chip program shape:
+    dp over reads, branch-parallel over hypotheses, collectives on ICI.
+
+    Returns ``step(d, e, off, act, cons, clen, reads, rlen, syms, wc, et)
+    -> (d', e', votes[B, A], total[B], reached_any[B], overflow)``.
+    """
+
+    def one_branch(d, e, off, act, cons, clen, reads, rlen, sym, wc, et):
+        W = d.shape[1]
+        emax = jnp.int32(W // 2)
+        kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
+        C = cons.shape[0]
+
+        cons2 = cons.at[jnp.clip(clen, 0, C - 1)].set(sym)
+        clen2 = clen + 1
+        d2, e2, overflow = _update_row(
+            d, e, off, act, cons2, clen2, reads, rlen, wc, et, kvec, emax
+        )
+        eds, occ, _split, reached = _stats_row(
+            d2, e2, off, act, cons2, clen2, reads, rlen, num_symbols, kvec
+        )
+        return d2, e2, (occ > 0).sum(axis=0), jnp.where(act, eds, 0).sum(), reached.any(), overflow
+
+    def body(d, e, off, act, cons, clen, reads, rlen, syms, wc, et):
+        d2, e2, local_votes, local_total, local_reached, local_ovf = jax.vmap(
+            one_branch, in_axes=(0, 0, 0, 0, 0, 0, None, None, 0, None, None)
+        )(d, e, off, act, cons, clen, reads, rlen, syms, wc, et)
+        votes = lax.psum(local_votes, read_axis)
+        total = lax.psum(local_total, read_axis)
+        reached = lax.psum(local_reached.astype(jnp.int32), read_axis) > 0
+        overflow = (
+            lax.psum(
+                local_ovf.any().astype(jnp.int32), (branch_axis, read_axis)
+            )
+            > 0
+        )
+        return d2, e2, votes, total, reached, overflow
+
+    bspec = lambda *rest: P(branch_axis, *rest)  # noqa: E731
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            bspec(read_axis, None),  # d
+            bspec(read_axis),  # e
+            bspec(read_axis),  # off
+            bspec(read_axis),  # act
+            bspec(None),  # cons
+            bspec(),  # clen
+            P(read_axis, None),  # reads
+            P(read_axis),  # rlen
+            bspec(),  # syms
+            P(),  # wc
+            P(),  # et
+        ),
+        out_specs=(
+            bspec(read_axis, None),
+            bspec(read_axis),
+            bspec(None),
+            bspec(),
+            bspec(),
+            P(),
+        ),
+    )
+    return jax.jit(sharded)

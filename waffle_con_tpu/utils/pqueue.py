@@ -131,6 +131,15 @@ class SetPriorityQueue:
         self._seq += 1
         return True
 
+    def peek_priority(self) -> Optional[Tuple]:
+        """Priority of the current best entry, or None when empty."""
+        while self._heap:
+            _neg, _seq, key = self._heap[0]
+            if key in self._live:
+                return self._live[key][0]
+            heapq.heappop(self._heap)
+        return None
+
     def pop(self) -> Tuple[Any, Any]:
         """Remove and return ``(item, priority)`` of the best entry."""
         while self._heap:
