@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Profile the JaxScorer device loop: steps/sec of run_extend, growth
+events, and per-call wall time, at a configurable problem size."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from waffle_con_tpu.config import CdwfaConfigBuilder
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.utils.example_gen import generate_test
+
+
+def main():
+    R = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    L = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 500
+    err = 0.01
+    mc = max(2, R // 4)
+    truth, reads = generate_test(4, L, R, err, seed=0)
+    cfg = CdwfaConfigBuilder().min_count(mc).build()
+    sc = JaxScorer(reads, cfg)
+    h = sc.root(np.ones(R, dtype=bool))
+
+    cons = b""
+    t_all = time.perf_counter()
+    calls = 0
+    while True:
+        t0 = time.perf_counter()
+        steps, code, app = sc.run_extend(h, cons, 10**9, mc, False, chunk)
+        dt = time.perf_counter() - t0
+        calls += 1
+        cons += app
+        per = dt / max(steps, 1) * 1e3
+        print(
+            f"len={len(cons):6d} steps={steps:4d} code={code} E={sc._E:4d} "
+            f"wall={dt:7.3f}s per_step={per:7.3f}ms"
+        )
+        if code == 2 or (steps == 0 and code not in (4, 5)):
+            break
+        if len(cons) > L + 200:
+            break
+    total = time.perf_counter() - t_all
+    print(
+        f"TOTAL: {total:.2f}s for {len(cons)} symbols in {calls} calls "
+        f"({total/max(len(cons),1)*1e3:.3f} ms/symbol), final E={sc._E}"
+    )
+
+
+if __name__ == "__main__":
+    main()
