@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Per-phase timing smoke test of the JaxScorer on the current device."""
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+t0 = time.perf_counter()
+from waffle_con_tpu.config import CdwfaConfigBuilder
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.utils.example_gen import generate_test
+
+print(f"import {time.perf_counter()-t0:.1f}s", flush=True)
+
+truth, reads = generate_test(4, 200, 16, 0.01, seed=0)
+cfg = CdwfaConfigBuilder().min_count(4).build()
+t0 = time.perf_counter()
+sc = JaxScorer(reads, cfg)
+h = sc.root(np.ones(16, dtype=bool))
+print(f"init+root {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+s = sc.push(h, truth[:1])
+print(f"first push {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+s = sc.push(h, truth[:2])
+print(f"second push {time.perf_counter()-t0:.3f}s", flush=True)
+t0 = time.perf_counter()
+steps, code, app = sc.run_extend(h, truth[:2], 10**9, 4, False, 100)
+print(
+    f"first run_extend (compile) {time.perf_counter()-t0:.1f}s "
+    f"steps={steps} code={code}",
+    flush=True,
+)
+cons = truth[:2] + app
+t0 = time.perf_counter()
+steps, code, app = sc.run_extend(h, cons, 10**9, 4, False, 100)
+print(
+    f"second run_extend {time.perf_counter()-t0:.3f}s steps={steps} "
+    f"code={code}",
+    flush=True,
+)
+t0 = time.perf_counter()
+eds = sc.finalized_eds(h, cons + app)
+print(f"finalize {time.perf_counter()-t0:.3f}s", flush=True)

@@ -1,18 +1,17 @@
 """Multi-device sharding tests on the virtual 8-CPU mesh: the sharded
-consensus step must agree exactly with the single-device kernels, and the
-driver entry points must compile and run."""
+column step must agree exactly with the single-device kernels, engines
+must run end-to-end through a read-sharded scorer with byte-identical
+results, and the driver entry points must compile and run."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from waffle_con_tpu.ops.jax_scorer import NEG, _stats_row, _update_row
-from waffle_con_tpu.parallel import (
-    make_mesh,
-    sharded_branch_step,
-    sharded_consensus_step,
-)
+from waffle_con_tpu import CdwfaConfigBuilder, ConsensusDWFA, DualConsensusDWFA
+from waffle_con_tpu.ops.jax_scorer import _col_step, _init_col, _stats_core
+from waffle_con_tpu.parallel import make_mesh, sharded_col_step
+from waffle_con_tpu.utils.example_gen import generate_test
 
 
 def needs_devices(n):
@@ -21,80 +20,106 @@ def needs_devices(n):
     )
 
 
-def _problem(B, R, W, L, seed=0):
+def _problem(R, W, L, seed=0):
     rng = np.random.default_rng(seed)
     reads = jnp.asarray(rng.integers(0, 4, size=(R, L)), dtype=jnp.int32)
     rlen = jnp.full((R,), L, dtype=jnp.int32)
-    d = jnp.full((B, R, W), NEG, dtype=jnp.int32).at[:, :, W // 2].set(0)
-    e = jnp.zeros((B, R), dtype=jnp.int32)
-    off = jnp.zeros((B, R), dtype=jnp.int32)
-    act = jnp.ones((B, R), dtype=bool)
-    cons = jnp.zeros((B, 64), dtype=jnp.int32)
-    clen = jnp.zeros((B,), dtype=jnp.int32)
-    return reads, rlen, d, e, off, act, cons, clen
+    off = jnp.zeros((R,), dtype=jnp.int32)
+    act = jnp.ones((R,), dtype=bool)
+    E = jnp.int32((W - 2) // 2)
+    D, e, rmin, er = _init_col(off, act, rlen, E, W)
+    cons = jnp.zeros((64,), dtype=jnp.int32)
+    clen = jnp.int32(0)
+    return reads, rlen, D, e, rmin, er, off, act, cons, clen
 
 
-def _reference_step(d, e, off, act, cons, clen, reads, rlen, sym):
-    W = d.shape[1]
-    emax = jnp.int32(W // 2)
-    kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
+def _reference_step(D, e, rmin, er, off, act, cons, clen, reads, rlen, sym):
+    W = D.shape[1]
+    E = jnp.int32((W - 2) // 2)
     cons2 = cons.at[jnp.clip(clen, 0, cons.shape[0] - 1)].set(sym)
     clen2 = clen + 1
-    d2, e2, ovf = _update_row(
-        d, e, off, act, cons2, clen2, reads, rlen,
-        jnp.int32(-2), jnp.bool_(False), kvec, emax,
+    D2, e2, rmin2, er2 = _col_step(
+        D, e, rmin, er, off, act, rlen, reads, clen2, sym,
+        jnp.int32(-2), jnp.bool_(False), E,
     )
-    eds, occ, _split, reached = _stats_row(
-        d2, e2, off, act, cons2, clen2, reads, rlen, 32, kvec
+    eds, occ, split, reached = _stats_core(
+        D2, e2, rmin2, er2, off, act, rlen, reads, clen2, 32, E
     )
-    votes = (occ > 0).sum(axis=0)
     total = jnp.where(act, eds, 0).sum()
-    return d2, e2, votes, total, reached.any()
+    return D2, e2, rmin2, er2, occ, split, total, reached.any()
 
 
 @needs_devices(8)
-def test_sharded_consensus_step_matches_single_device():
+def test_sharded_col_step_matches_single_device():
     mesh = make_mesh(8, axis_names=("read",))
-    step = sharded_consensus_step(mesh)
-    reads, rlen, d, e, off, act, cons, clen = _problem(1, 16, 17, 24)
+    step = sharded_col_step(mesh)
+    reads, rlen, D, e, rmin, er, off, act, cons, clen = _problem(16, 18, 24)
     sym = jnp.int32(2)
 
-    d2, e2, votes, total, reached, overflow = step(
-        d[0], e[0], off[0], act[0], cons[0], clen[0], reads, rlen, sym,
+    out = step(
+        D, e, rmin, er, off, act, cons, clen, reads, rlen, sym,
         jnp.int32(-2), jnp.bool_(False),
     )
-    rd, re_, rvotes, rtotal, rreached = _reference_step(
-        d[0], e[0], off[0], act[0], cons[0], clen[0], reads, rlen, sym
+    ref = _reference_step(
+        D, e, rmin, er, off, act, cons, clen, reads, rlen, sym
     )
-    np.testing.assert_array_equal(np.asarray(d2), np.asarray(rd))
-    np.testing.assert_array_equal(np.asarray(e2), np.asarray(re_))
-    np.testing.assert_array_equal(np.asarray(votes), np.asarray(rvotes))
-    assert int(total) == int(rtotal)
-    assert bool(reached) == bool(rreached)
-    assert not bool(overflow)
+    names = ["D", "e", "rmin", "er", "occ", "split"]
+    for name, got, want in zip(names, out[:6], ref[:6]):
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=name
+        )
+    assert int(out[6]) == int(ref[6])
+    assert bool(out[7]) == bool(ref[7])
+    assert not bool(out[8])
 
 
 @needs_devices(8)
-def test_sharded_branch_step_matches_single_device():
-    mesh = make_mesh(8, shape=(2, 4), axis_names=("branch", "read"))
-    step = sharded_branch_step(mesh)
-    reads, rlen, d, e, off, act, cons, clen = _problem(4, 8, 17, 24, seed=2)
-    syms = jnp.asarray([0, 1, 2, 3], dtype=jnp.int32)
+def test_engine_through_sharded_scorer_single():
+    """ConsensusDWFA end-to-end on an 8-device read-sharded scorer
+    (selected purely via config), byte-identical to the python oracle."""
+    truth, reads = generate_test(4, 60, 8, 0.02, seed=11)
 
-    d2, e2, votes, total, reached, overflow = step(
-        d, e, off, act, cons, clen, reads, rlen, syms,
-        jnp.int32(-2), jnp.bool_(False),
+    expected = ConsensusDWFA(
+        CdwfaConfigBuilder().min_count(2).backend("python").build()
     )
-    for b in range(4):
-        rd, re_, rvotes, rtotal, rreached = _reference_step(
-            d[b], e[b], off[b], act[b], cons[b], clen[b], reads, rlen, syms[b]
-        )
-        np.testing.assert_array_equal(np.asarray(d2[b]), np.asarray(rd))
-        np.testing.assert_array_equal(np.asarray(e2[b]), np.asarray(re_))
-        np.testing.assert_array_equal(np.asarray(votes[b]), np.asarray(rvotes))
-        assert int(total[b]) == int(rtotal)
-        assert bool(reached[b]) == bool(rreached)
-    assert not bool(overflow)
+    for r in reads:
+        expected.add_sequence(r)
+    want = expected.consensus()
+
+    engine = ConsensusDWFA(
+        CdwfaConfigBuilder().min_count(2).backend("jax").mesh_shards(8).build()
+    )
+    for r in reads:
+        engine.add_sequence(r)
+    got = engine.consensus()
+    assert got == want
+    assert got[0].sequence == truth
+
+
+@needs_devices(8)
+def test_engine_through_sharded_scorer_dual():
+    """DualConsensusDWFA through the sharded scorer: haplotype split with
+    exact per-read vote parity."""
+    sequences = [b"ACGTACGT", b"ACGTACGT", b"AGGTACGT", b"AGGTACGT"] * 2
+
+    expected = DualConsensusDWFA(
+        CdwfaConfigBuilder().min_count(1).backend("python").build()
+    )
+    for s in sequences:
+        expected.add_sequence(s)
+    want = expected.consensus()
+
+    engine = DualConsensusDWFA(
+        CdwfaConfigBuilder()
+        .min_count(1)
+        .backend("jax")
+        .mesh_shards(8)
+        .build()
+    )
+    for s in sequences:
+        engine.add_sequence(s)
+    got = engine.consensus()
+    assert got == want
 
 
 @needs_devices(8)
@@ -112,5 +137,3 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     mod.dryrun_multichip(8)
-    mod.dryrun_multichip(4)
-    mod.dryrun_multichip(1)

@@ -88,12 +88,19 @@ class CdwfaConfig:
     #: or "jax" (batched TPU scorer).  Framework extension beyond the
     #: reference config.
     backend: str = "python"
+    #: Shard the jax scorer's read axis over this many devices (a
+    #: ``jax.sharding.Mesh`` over the first N devices; 0 = single-device).
+    #: Engines are sharding-agnostic: results are identical on 1 or N
+    #: chips.  Framework extension beyond the reference config.
+    mesh_shards: int = 0
 
     def __post_init__(self) -> None:
         if self.wildcard is not None and not 0 <= self.wildcard <= 255:
             raise ValueError("wildcard must be a byte value (0..=255)")
         if self.backend not in ("python", "native", "jax"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.mesh_shards and self.backend != "jax":
+            raise ValueError("mesh_shards requires the jax backend")
 
 
 class CdwfaConfigBuilder:

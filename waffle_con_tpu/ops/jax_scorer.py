@@ -1,41 +1,56 @@
-"""Batched JAX/TPU wavefront scorer.
+"""Batched JAX/TPU scorer: banded edit-distance column DP.
 
 The TPU-native implementation of the
 :class:`~waffle_con_tpu.ops.scorer.WavefrontScorer` seam.  Where the
-reference iterates a ``Vec<DWFALite>`` serially per consensus symbol
-(``/root/reference/src/consensus.rs:455-463``), this scorer keeps *every*
-branch's per-read wavefront in device arrays and advances all of them in
-fused XLA kernels:
+reference maintains one incremental wavefront object per read and mutates
+it serially per appended consensus symbol
+(``/root/reference/src/consensus.rs:455-463``,
+``/root/reference/src/dynamic_wfa.rs:75-191``), this scorer re-derives
+every DWFA observable from a *banded Levenshtein column* and advances all
+(branch, read) lanes in one fused, fixed-shape XLA step per symbol — no
+data-dependent control flow, no per-lane gathers, nothing that fights the
+TPU's vector unit.
 
-* ``d``   — ``[B, R, W] int32``: bases consumed in the consensus per
-  (branch-slot, read, diagonal), ``W = 2*E_max + 1`` diagonals in
-  *centered* coordinates (``k = column - E``, baseline position is simply
-  ``d - k``); invalid diagonals hold a large negative sentinel.
-* ``e/off/act`` — ``[B, R]``: per-read edit distance, consensus offset,
-  tracking flag.
-* ``cons/clen`` — ``[B, C]``: the per-branch consensus (dense symbol ids).
+Equivalence (proved against the oracle by the parity suite): let
+``D[j, i]`` be the edit distance between ``cons[off:j]`` and ``read[:i]``.
+For a band of half-width ``E`` around the main diagonal:
 
-One ``update`` call performs the greedy diagonal extension (lock-step
-``lax.while_loop`` — every (read, diagonal) lane advances while its
-characters match) interleaved with per-read edit-distance escalation (a
-3-point stencil in diagonal space: ``new[k] = max(old[k+1], old[k]+1,
-old[k-1]+1)``), exactly the semantics of
-``DWFALite::update`` (``/root/reference/src/dynamic_wfa.rs:75-191``).
+* ``DWFALite.edit_distance`` after ``update`` == the running column
+  minimum ``colmin_j = min_i D[j, i]`` (monotone in ``j``), except under
+  early termination where it freezes (below).
+* tip votes (``get_extension_candidates``,
+  ``/root/reference/src/dynamic_wfa.rs:241-255``) == the multiset of
+  ``read[i]`` over band cells with ``D[j, i] <= e`` and ``i < len(read)``
+  — each wavefront diagonal maps to exactly one column cell.
+* ``finalize`` == ``max(e, rmin)`` where ``rmin = min_{j' <= j} D[j',
+  len(read)]`` is a running minimum over the read-end row.
+* ``reached_baseline_end`` has the reference's overshoot semantics
+  (``max_base == blen`` with out-of-bounds deletion entries): the
+  wavefront first touches the read end at cost ``er = max(e, rmin)`` and
+  every later escalation pushes ``max_base`` past the end, so
+  ``reached == (e == er)`` with ``er`` latched at first touch.
+* early termination stops escalation once reached:
+  ``e' = min(colmin, max(e, rmin))`` while unlatched, frozen afterwards.
 
-Dynamic wavefront growth is handled by bucketing: when any read would need
-``e > E_max`` the kernel reports overflow without committing state, and
-the host re-buckets (doubles ``E_max``, recenters the buffers) and
-retries.  Shapes are padded to powers of two to bound XLA recompiles.
+Each column step costs ~30 vector ops on ``[R, W]`` lanes (the insertion
+chain is a ``cummin`` prefix scan), so whole unambiguous consensus runs
+execute on device via ``lax.while_loop`` with one host round-trip per
+*event* — the design target that makes the search loop TPU-viable.
 
-Sharding: reads are the embarrassingly-parallel axis.  All kernels are
-pure functions of arrays whose read axis can be sharded over a
-``jax.sharding.Mesh`` — :mod:`waffle_con_tpu.parallel` provides the
-``shard_map`` wrappers with ``psum`` vote reductions.
+Band growth: values are exact wherever ``D < E``; when a reported
+quantity would reach ``E`` the kernel refuses to commit and the host
+doubles ``E`` and *replays* the columns from the recorded per-branch
+consensus (the band holds only a window, so unlike a wavefront it cannot
+be re-padded in place).  Growth is geometric, replays are rare and run as
+one device scan.
+
+Sharding: all state is ``[B, R, W]`` with reads as the embarrassingly
+parallel axis; :mod:`waffle_con_tpu.parallel` places these arrays over a
+``jax.sharding.Mesh`` so the same kernels run 1-chip or N-chip.
 """
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -47,7 +62,7 @@ from jax import lax
 from waffle_con_tpu.config import CdwfaConfig
 from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
 
-NEG = jnp.int32(-(1 << 28))
+INF = jnp.int32(1 << 20)
 
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
@@ -55,271 +70,248 @@ def _next_pow2(n: int, minimum: int = 1) -> int:
 
 
 # ======================================================================
-# single-branch kernels (row = one branch), vmapped/batched by callers.
-# All take dense-id arrays; `wc` is the wildcard dense id or -2; `et` is
-# allow_early_termination as a traced bool scalar.
+# column kernels.  A "row" is one branch: D [R, W] plus per-read scalars.
+# All dense symbol ids; `wc` is the wildcard dense id or -2; `et` is
+# allow_early_termination as a traced bool.
 
 
-def _valid_mask(e, kvec):
-    return jnp.abs(kvec)[None, :] <= e[:, None]
+def _init_col(off, act, rlen, E, W):
+    """Fresh DP column at ``j == off`` (nothing of the consensus consumed):
+    cost of read prefix ``i`` is ``i``.  Returns (D, e, rmin, er)."""
+    t = jnp.arange(W, dtype=jnp.int32)[None, :]
+    i0 = t - E  # j - off - E + t with j == off
+    D = jnp.where((i0 >= 0) & (i0 <= rlen[:, None]), i0, INF)
+    D = jnp.where(act[:, None], D, INF)
+    e = jnp.zeros(off.shape, jnp.int32)
+    rmin = jnp.where(act & (rlen <= E + 1), rlen, INF)
+    er = jnp.where(rmin <= 0, 0, INF)
+    return D, e, rmin, er
 
 
-def _extend(d, e, off, act, cons, clen, reads, rlen, wc, kvec):
-    """Greedy furthest-reaching extension of all (read, diagonal) lanes
-    (parity: ``DWFALite::extend``, ``/root/reference/src/dynamic_wfa.rs:109-153``)."""
+def _col_step(D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E):
+    """Advance one branch's banded columns from ``jnew-1`` to ``jnew`` by
+    consuming consensus symbol ``sym``; returns updated (D, e, rmin, er)
+    with inactive reads passed through unchanged."""
+    R, W = D.shape
     L = reads.shape[1]
-    C = cons.shape[0]
+    t = jnp.arange(W, dtype=jnp.int32)[None, :]
+    i_new = jnew - off[:, None] - E + t
 
-    def step(dcur):
-        valid = act[:, None] & _valid_mask(e, kvec)
-        bo = dcur - kvec[None, :]
-        oo = dcur + off[:, None]
-        inb = (
-            (bo >= 0)
-            & (bo < rlen[:, None])
-            & (oo >= 0)
-            & (oo < clen)
-        )
-        bchar = jnp.take_along_axis(reads, jnp.clip(bo, 0, L - 1), axis=1)
-        ochar = cons[jnp.clip(oo, 0, C - 1)]
-        match = (bchar == ochar) | (bchar == wc)
-        adv = valid & inb & match
-        return dcur + adv.astype(dcur.dtype), adv.any()
+    bchar = jnp.take_along_axis(reads, jnp.clip(i_new - 1, 0, L - 1), axis=1)
+    sub = ((bchar != sym) & (bchar != wc)).astype(jnp.int32)
 
-    d, again = step(d)
-    d, _ = lax.while_loop(
-        lambda carry: carry[1], lambda carry: step(carry[0]), (d, again)
+    diag = D + sub
+    dele = jnp.concatenate([D[:, 1:], jnp.full_like(D[:, :1], INF)], axis=1) + 1
+    base = jnp.minimum(diag, dele)
+    invalid = (i_new < 0) | (i_new > rlen[:, None])
+    base = jnp.where(invalid, INF, base)
+    # insertion chain within the column: prefix-min of (base - t) + t
+    chain = lax.cummin(base - t, axis=1)
+    Dn = jnp.minimum(jnp.minimum(base, chain + t), INF)
+
+    colmin = Dn.min(axis=1)
+    rend = jnp.where(i_new == rlen[:, None], Dn, INF).min(axis=1)
+    rmin_n = jnp.minimum(rmin, rend)
+    e_uncapped = jnp.maximum(e, colmin)
+    e_capped = jnp.where(
+        er < INF, e, jnp.maximum(e, jnp.minimum(colmin, jnp.maximum(e, rmin_n)))
     )
-    return d
+    e_n = jnp.where(et, e_capped, e_uncapped)
+    er_n = jnp.where(
+        er < INF, er, jnp.where(rmin_n <= e_n, jnp.maximum(e, rmin_n), INF)
+    )
+
+    keep = act
+    D = jnp.where(keep[:, None], Dn, D)
+    e = jnp.where(keep, e_n, e)
+    rmin = jnp.where(keep, rmin_n, rmin)
+    er = jnp.where(keep, er_n, er)
+    return D, e, rmin, er
 
 
-def _maxima(d, e, off, kvec):
-    valid = _valid_mask(e, kvec)
-    dv = jnp.where(valid, d, NEG)
-    max_other = off + dv.max(axis=1)
-    max_base = jnp.where(valid, d - kvec[None, :], NEG).max(axis=1)
-    return max_other, max_base
-
-
-def _escalate_once(d, e, need, kvec):
-    """Grow needy reads' wavefronts by one edit: 3-point stencil in
-    diagonal space (parity: ``DWFALite::increase_edit_distance``,
-    ``/root/reference/src/dynamic_wfa.rs:162-191``)."""
-    up = jnp.concatenate([d[:, 1:], jnp.full_like(d[:, :1], NEG)], axis=1)
-    down = jnp.concatenate([jnp.full_like(d[:, :1], NEG), d[:, :-1]], axis=1)
-    cand = jnp.maximum(jnp.maximum(up, d + 1), down + 1)
-    e_new = e + need.astype(e.dtype)
-    newvalid = _valid_mask(e_new, kvec)
-    d_new = jnp.where(newvalid, cand, NEG)
-    d = jnp.where(need[:, None], d_new, d)
-    return d, e_new
-
-
-def _update_row(d, e, off, act, cons, clen, reads, rlen, wc, et, kvec, emax):
-    """Full ``update``: extend, then escalate+re-extend until every active
-    read consumed the whole consensus (or hit its baseline end under early
-    termination).  Returns ``(d, e, overflow)``; on overflow the caller
-    must discard the state and re-bucket."""
-
-    def need_mask(dcur, ecur):
-        max_other, max_base = _maxima(dcur, ecur, off, kvec)
-        reached = max_base == rlen
-        return act & (max_other < clen) & ~(et & reached)
-
-    d = _extend(d, e, off, act, cons, clen, reads, rlen, wc, kvec)
-
-    def cond(carry):
-        dcur, ecur = carry
-        need = need_mask(dcur, ecur)
-        can = need & (ecur < emax)
-        return can.any() & ~(need & (ecur >= emax)).any()
-
-    def body(carry):
-        dcur, ecur = carry
-        need = need_mask(dcur, ecur)
-        dcur, ecur = _escalate_once(dcur, ecur, need, kvec)
-        dcur = _extend(dcur, ecur, off, act, cons, clen, reads, rlen, wc, kvec)
-        return dcur, ecur
-
-    d, e = lax.while_loop(cond, body, (d, e))
-    overflow = (need_mask(d, e) & (e >= emax)).any()
-    return d, e, overflow
-
-
-def _finalize_row(d, e, off, act, cons, clen, reads, rlen, wc, kvec, emax):
-    """Escalate until every active read's wavefront touches its baseline
-    end (parity: ``DWFALite::finalize``,
-    ``/root/reference/src/dynamic_wfa.rs:201-210``)."""
-
-    def need_mask(dcur, ecur):
-        _, max_base = _maxima(dcur, ecur, off, kvec)
-        return act & (max_base < rlen)
-
-    def cond(carry):
-        dcur, ecur = carry
-        need = need_mask(dcur, ecur)
-        return (need & (ecur < emax)).any() & ~(need & (ecur >= emax)).any()
-
-    def body(carry):
-        dcur, ecur = carry
-        need = need_mask(dcur, ecur)
-        dcur, ecur = _escalate_once(dcur, ecur, need, kvec)
-        dcur = _extend(dcur, ecur, off, act, cons, clen, reads, rlen, wc, kvec)
-        return dcur, ecur
-
-    d, e = lax.while_loop(cond, body, (d, e))
-    overflow = (need_mask(d, e) & (e >= emax)).any()
-    return e, overflow
-
-
-def _stats_row(d, e, off, act, cons, clen, reads, rlen, num_symbols, kvec):
-    """Snapshot: per-read edit distance, baseline-end flags, and the tip
-    vote histogram over dense symbols (parity:
-    ``DWFALite::get_extension_candidates``,
-    ``/root/reference/src/dynamic_wfa.rs:241-255``)."""
+def _stats_core(D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E):
+    """Snapshot of one branch: per-read edit distance, tip votes over dense
+    symbols, reached flags (reference overshoot semantics)."""
+    R, W = D.shape
     L = reads.shape[1]
-    valid = act[:, None] & _valid_mask(e, kvec)
-    _, max_base = _maxima(d, e, off, kvec)
-    reached = act & (max_base == rlen)
-    eds = jnp.where(act, e, 0)
-
-    bo = d - kvec[None, :]
-    tip = valid & (d + off[:, None] == clen) & (bo >= 0) & (bo < rlen[:, None])
-    sym = jnp.take_along_axis(reads, jnp.clip(bo, 0, L - 1), axis=1)
-    onehot = (sym[:, :, None] == jnp.arange(num_symbols)[None, None, :]) & tip[
+    t = jnp.arange(W, dtype=jnp.int32)[None, :]
+    i = clen - off[:, None] - E + t
+    tip = act[:, None] & (D <= e[:, None]) & (i >= 0) & (i < rlen[:, None])
+    vchar = jnp.take_along_axis(reads, jnp.clip(i, 0, L - 1), axis=1)
+    onehot = (vchar[:, :, None] == jnp.arange(num_symbols)[None, None, :]) & tip[
         :, :, None
     ]
     occ = onehot.sum(axis=1, dtype=jnp.int32)
     split = occ.sum(axis=1)
+    reached = act & (er < INF) & (e == er)
+    eds = jnp.where(act, e, 0)
     return eds, occ, split, reached
 
 
 # ======================================================================
-# whole-state jitted entry points.  state = dict of arrays; shapes drive
-# jax's compile cache.
+# whole-state jitted entry points.  state = dict of arrays; all donate the
+# state buffers (every overflowing op masks its commit, so the returned
+# state is unchanged when the host must re-bucket and retry).
 
 
-def _fresh_read_row(W):
-    row = jnp.full((W,), NEG, dtype=jnp.int32)
-    return row.at[W // 2].set(0)
+@partial(jax.jit, donate_argnums=(0,))
+def _j_root(state, rlen, h, act):
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    off = jnp.zeros_like(state["off"][h])
+    D, e, rmin, er = _init_col(off, act, rlen, E, W)
+    out = dict(state)
+    out["D"] = state["D"].at[h].set(D)
+    out["e"] = state["e"].at[h].set(e)
+    out["rmin"] = state["rmin"].at[h].set(rmin)
+    out["er"] = state["er"].at[h].set(er)
+    out["off"] = state["off"].at[h].set(0)
+    out["act"] = state["act"].at[h].set(act)
+    out["clen"] = state["clen"].at[h].set(0)
+    return out
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _j_clone(state, src, dst):
     out = dict(state)
-    for name in ("d", "e", "off", "act", "cons", "clen"):
+    for name in ("D", "e", "rmin", "er", "off", "act", "cons", "clen"):
         out[name] = state[name].at[dst].set(state[name][src])
     return out
 
 
-@partial(jax.jit, static_argnames=("num_symbols",))
-def _j_push(state, reads, rlen, h, sym, wc, et, num_symbols):
-    W = state["d"].shape[2]
-    emax = jnp.int32(W // 2)
-    kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
-    C = state["cons"].shape[1]
-
-    clen0 = state["clen"][h]
-    cons = state["cons"].at[h, jnp.clip(clen0, 0, C - 1)].set(sym)
-    clen = state["clen"].at[h].add(1)
-
-    d, e, overflow = _update_row(
-        state["d"][h],
-        state["e"][h],
-        state["off"][h],
-        state["act"][h],
-        cons[h],
-        clen[h],
-        reads,
-        rlen,
-        wc,
-        et,
-        kvec,
-        emax,
-    )
-    out = dict(state)
-    out["cons"] = cons
-    out["clen"] = clen
-    out["d"] = state["d"].at[h].set(d)
-    out["e"] = state["e"].at[h].set(e)
-    eds, occ, split, reached = _stats_row(
-        d, e, out["off"][h], out["act"][h], cons[h], clen[h], reads, rlen,
-        num_symbols, kvec,
-    )
-    return out, (eds, occ, split, reached), overflow
-
-
-@partial(jax.jit, static_argnames=("num_symbols",))
-def _j_stats(state, reads, rlen, h, num_symbols):
-    W = state["d"].shape[2]
-    kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
-    return _stats_row(
-        state["d"][h],
-        state["e"][h],
-        state["off"][h],
-        state["act"][h],
-        state["cons"][h],
-        state["clen"][h],
-        reads,
-        rlen,
-        num_symbols,
-        kvec,
-    )
-
-
-@jax.jit
-def _j_activate(state, reads, rlen, h, read_index, offset, wc, et):
-    W = state["d"].shape[2]
-    emax = jnp.int32(W // 2)
-    kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
-
-    d0 = state["d"][h].at[read_index].set(_fresh_read_row(W))
-    e0 = state["e"][h].at[read_index].set(0)
-    off0 = state["off"][h].at[read_index].set(offset)
-    act0 = state["act"][h].at[read_index].set(True)
-
-    d, e, overflow = _update_row(
-        d0, e0, off0, act0, state["cons"][h], state["clen"][h],
-        reads, rlen, wc, et, kvec, emax,
-    )
-    out = dict(state)
-    out["d"] = state["d"].at[h].set(d)
-    out["e"] = state["e"].at[h].set(e)
-    out["off"] = state["off"].at[h].set(off0)
-    out["act"] = state["act"].at[h].set(act0)
-    return out, overflow
-
-
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _j_deactivate(state, h, read_index):
     out = dict(state)
     out["act"] = state["act"].at[h, read_index].set(False)
     return out
 
 
-@jax.jit
-def _j_finalize(state, reads, rlen, h, wc):
-    W = state["d"].shape[2]
-    emax = jnp.int32(W // 2)
-    kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
-    e, overflow = _finalize_row(
-        state["d"][h],
-        state["e"][h],
-        state["off"][h],
-        state["act"][h],
-        state["cons"][h],
-        state["clen"][h],
-        reads,
-        rlen,
-        wc,
-        kvec,
-        emax,
+@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
+def _j_push_batch(state, reads, rlen, hs, syms, wc, et, num_symbols):
+    """Advance a batch of branch slots by one symbol each (``hs`` may
+    contain duplicate padding entries as long as their ``syms`` agree).
+    Returns (state, stats-per-branch, overflow)."""
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    C = state["cons"].shape[1]
+
+    def one(D, e, rmin, er, off, act, clen, sym):
+        jnew = clen + 1
+        Dn, en, rminn, ern = _col_step(
+            D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
+        )
+        ovf = (act & (en >= E)).any()
+        stats = _stats_core(
+            Dn, en, rminn, ern, off, act, rlen, reads, jnew, num_symbols, E
+        )
+        return Dn, en, rminn, ern, ovf, stats
+
+    Dn, en, rminn, ern, ovfs, stats = jax.vmap(one)(
+        state["D"][hs],
+        state["e"][hs],
+        state["rmin"][hs],
+        state["er"][hs],
+        state["off"][hs],
+        state["act"][hs],
+        state["clen"][hs],
+        syms,
     )
-    eds = jnp.where(state["act"][h], e, 0)
-    return eds, overflow
+    overflow = ovfs.any()
+    out = dict(state)
+
+    def commit(new, old):
+        return jnp.where(overflow, old, new)
+
+    out["D"] = state["D"].at[hs].set(commit(Dn, state["D"][hs]))
+    out["e"] = state["e"].at[hs].set(commit(en, state["e"][hs]))
+    out["rmin"] = state["rmin"].at[hs].set(commit(rminn, state["rmin"][hs]))
+    out["er"] = state["er"].at[hs].set(commit(ern, state["er"][hs]))
+    cons_rows = state["cons"][hs]
+    clen_rows = state["clen"][hs]
+    cons_upd = cons_rows.at[
+        jnp.arange(hs.shape[0]), jnp.clip(clen_rows, 0, C - 1)
+    ].set(syms)
+    out["cons"] = state["cons"].at[hs].set(commit(cons_upd, cons_rows))
+    out["clen"] = state["clen"].at[hs].set(commit(clen_rows + 1, clen_rows))
+    return out, stats, overflow
 
 
 @partial(jax.jit, static_argnames=("num_symbols",))
+def _j_stats(state, reads, rlen, h, num_symbols):
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    return _stats_core(
+        state["D"][h],
+        state["e"][h],
+        state["rmin"][h],
+        state["er"][h],
+        state["off"][h],
+        state["act"][h],
+        rlen,
+        reads,
+        state["clen"][h],
+        num_symbols,
+        E,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _j_activate(state, reads, rlen, h, read_index, offset, wc, et):
+    """Begin tracking one read at consensus offset ``offset``: fresh column
+    at ``j == offset``, then catch up to the branch's current length."""
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    clen = state["clen"][h]
+    cons = state["cons"][h]
+
+    off1 = jnp.full((1,), offset, jnp.int32)
+    act1 = jnp.ones((1,), bool)
+    rlen1 = rlen[read_index][None]
+    reads1 = reads[read_index][None]
+    D1, e1, rmin1, er1 = _init_col(off1, act1, rlen1, E, W)
+
+    def body(j, carry):
+        D, e, rmin, er = carry
+        return _col_step(
+            D, e, rmin, er, off1, act1, rlen1, reads1, j + 1, cons[j], wc, et, E
+        )
+
+    D1, e1, rmin1, er1 = lax.fori_loop(offset, clen, body, (D1, e1, rmin1, er1))
+    overflow = e1[0] >= E
+
+    out = dict(state)
+
+    def commit(field, new):
+        old = state[field][h, read_index]
+        return state[field].at[h, read_index].set(
+            jnp.where(overflow, old, new)
+        )
+
+    out["D"] = commit("D", D1[0])
+    out["e"] = commit("e", e1[0])
+    out["rmin"] = commit("rmin", rmin1[0])
+    out["er"] = commit("er", er1[0])
+    out["off"] = commit("off", jnp.where(overflow, state["off"][h, read_index], offset))
+    out["act"] = state["act"].at[h, read_index].set(
+        jnp.where(overflow, state["act"][h, read_index], True)
+    )
+    return out, overflow
+
+
+@jax.jit
+def _j_finalize(state, h):
+    """Finalized per-read edit distances (reference semantics:
+    ``max(e, rmin)`` — escalate only until the wavefront touches the
+    baseline end).  Non-mutating."""
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    act = state["act"][h]
+    fin = jnp.maximum(state["e"][h], state["rmin"][h])
+    overflow = (act & (fin >= E)).any()
+    return jnp.where(act, jnp.minimum(fin, INF), 0), overflow
+
+
+@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
 def _j_run(
     state, reads, rlen, h, budget, min_count, l2, wc, et, max_steps,
     num_symbols,
@@ -331,24 +323,23 @@ def _j_run(
 
     Stop codes: 1 = votes need host arbitration (non-one-hot, wildcard
     votes, or #passing != 1), 2 = some read reached its baseline end,
-    3 = node cost exceeded the budget, 4 = step limit, 5 = wavefront
-    bucket overflow (last push not committed).
+    3 = node cost exceeded the budget, 4 = step limit, 5 = band overflow
+    (last push not committed).
 
     This is the TPU answer to the reference's symbol-at-a-time host loop:
     for clean stretches the consensus grows entirely on device, with one
     host round-trip per *event* instead of per base.
     """
-    W = state["d"].shape[2]
-    emax = jnp.int32(W // 2)
-    kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
     off = state["off"][h]
     act = state["act"][h]
 
     def body(carry):
-        d, e, cons, clen, steps, _code = carry
-        eds, occ, split, reached = _stats_row(
-            d, e, off, act, cons, clen, reads, rlen, num_symbols, kvec
+        D, e, rmin, er, cons, clen, steps, _code = carry
+        eds, occ, split, reached = _stats_core(
+            D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
         )
         # int32-safe cost total: with L2 and huge per-read distances the
         # squared sum could wrap, so treat that regime as a host event
@@ -367,7 +358,8 @@ def _j_run(
         n_cands = has_votes.sum()
         frac = jnp.where(
             split[:, None] > 0,
-            occ.astype(jnp.float32) / jnp.maximum(split, 1)[:, None].astype(jnp.float32),
+            occ.astype(jnp.float32)
+            / jnp.maximum(split, 1)[:, None].astype(jnp.float32),
             0.0,
         )
         counts = frac.sum(axis=0)  # [A]
@@ -410,62 +402,93 @@ def _j_run(
         sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(jnp.int32)
         cons2 = cons.at[jnp.clip(clen, 0, C - 1)].set(sym)
         clen2 = clen + 1
-        d2, e2, ovf = _update_row(
-            d, e, off, act, cons2, clen2, reads, rlen, wc, et, kvec, emax
+        D2, e2, rmin2, er2 = _col_step(
+            D, e, rmin, er, off, act, rlen, reads, clen2, sym, wc, et, E
         )
+        ovf = (act & (e2 >= E)).any()
         commit = (code == 0) & ~ovf
         code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
-        d = jnp.where(commit, d2, d)
+        D = jnp.where(commit, D2, D)
         e = jnp.where(commit, e2, e)
+        rmin = jnp.where(commit, rmin2, rmin)
+        er = jnp.where(commit, er2, er)
         cons = jnp.where(commit, cons2, cons)
         clen = jnp.where(commit, clen2, clen)
         steps = steps + commit.astype(steps.dtype)
-        return d, e, cons, clen, steps, code
+        return D, e, rmin, er, cons, clen, steps, code
 
     init = (
-        state["d"][h],
+        state["D"][h],
         state["e"][h],
+        state["rmin"][h],
+        state["er"][h],
         state["cons"][h],
         state["clen"][h],
         jnp.int32(0),
         jnp.int32(0),
     )
-    d, e, cons, clen, steps, code = lax.while_loop(
-        lambda c: c[5] == 0, body, init
+    D, e, rmin, er, cons, clen, steps, code = lax.while_loop(
+        lambda c: c[7] == 0, body, init
     )
     out = dict(state)
-    out["d"] = state["d"].at[h].set(d)
+    out["D"] = state["D"].at[h].set(D)
     out["e"] = state["e"].at[h].set(e)
+    out["rmin"] = state["rmin"].at[h].set(rmin)
+    out["er"] = state["er"].at[h].set(er)
     out["cons"] = state["cons"].at[h].set(cons)
     out["clen"] = state["clen"].at[h].set(clen)
     return out, steps, code
 
 
-@jax.jit
-def _j_root(state, h, act):
-    W = state["d"].shape[2]
-    out = dict(state)
-    out["d"] = state["d"].at[h].set(
-        jnp.broadcast_to(_fresh_read_row(W), state["d"].shape[1:])
-    )
-    out["e"] = state["e"].at[h].set(0)
-    out["off"] = state["off"].at[h].set(0)
-    out["act"] = state["act"].at[h].set(act)
-    out["clen"] = state["clen"].at[h].set(0)
-    return out
+@partial(jax.jit, static_argnames=("W",))
+def _j_replay(off, act, cons, clen, reads, rlen, wc, et, W: int):
+    """Rebuild all branch DP state at band width ``W`` by replaying every
+    branch's recorded consensus from scratch (used after band growth: a
+    band is a window, so unlike the reference's wavefront it cannot be
+    re-padded in place).  One device scan over the longest consensus."""
+    E = jnp.int32((W - 2) // 2)
+    B, R = off.shape
 
+    # every read starts from the init column at its own DP anchor (its
+    # activation offset), already present in D0; the loop only *steps*
+    # reads whose anchor is behind the current column
+    D0, e0, rmin0, er0 = jax.vmap(
+        lambda o, a: _init_col(o, a, rlen, E, W)
+    )(off, act)
+    maxlen = clen.max()
 
-class ScorerOverflow(Exception):
-    """Internal: a kernel needed a larger wavefront bucket."""
+    def body(j, carry):
+        D, e, rmin, er = carry
+
+        def per_branch(Db, eb, rminb, erb, offb, actb, consb, clenb):
+            sym = consb[jnp.clip(j, 0, consb.shape[0] - 1)]
+            Dn, en, rminn, ern = _col_step(
+                Db, eb, rminb, erb, offb, actb, rlen, reads, j + 1, sym, wc,
+                et, E,
+            )
+            stepm = actb & (offb <= j) & (j < clenb)
+            sel = lambda new, old: jnp.where(stepm, new, old)  # noqa: E731
+            return (
+                jnp.where(stepm[:, None], Dn, Db),
+                sel(en, eb),
+                sel(rminn, rminb),
+                sel(ern, erb),
+            )
+
+        return jax.vmap(per_branch)(
+            D, e, rmin, er, off, act, cons, clen
+        )
+
+    D, e, rmin, er = lax.fori_loop(0, maxlen, body, (D0, e0, rmin0, er0))
+    return D, e, rmin, er
 
 
 class JaxScorer(WavefrontScorer):
-    """Device-resident branch store.
+    """Device-resident branch store over the banded column DP.
 
     Handles are host-side ids mapped to device slots; slot/geometry growth
-    (branch count, consensus capacity, wavefront bucket) recompiles the
-    kernels for the new shapes — growth doubles, so recompiles are
-    logarithmic.
+    (branch count, consensus capacity, band width) recompiles the kernels
+    for the new shapes — growth doubles, so recompiles are logarithmic.
     """
 
     INITIAL_E = 8
@@ -474,7 +497,11 @@ class JaxScorer(WavefrontScorer):
     def __init__(self, reads: Sequence[bytes], config: CdwfaConfig) -> None:
         super().__init__(reads, config)
         n = len(self.reads)
-        self._R = _next_pow2(n)
+        self._R = _next_pow2(max(n, 1))
+        ms = config.mesh_shards or 1
+        if self._R % ms:
+            self._R = ms * ((self._R + ms - 1) // ms)
+        self._shardings = None  # installed by parallel.shard_scorer
         max_len = max((len(r) for r in self.reads), default=1)
         self._L = _next_pow2(max(max_len, 1))
 
@@ -503,38 +530,57 @@ class JaxScorer(WavefrontScorer):
 
     # -- geometry ------------------------------------------------------
 
+    @property
+    def _W(self) -> int:
+        return 2 * self._E + 2
+
     def _blank_state(self):
-        W = 2 * self._E + 1
         return {
-            "d": jnp.full((self._B, self._R, W), NEG, dtype=jnp.int32),
+            "D": jnp.full((self._B, self._R, self._W), INF, dtype=jnp.int32),
             "e": jnp.zeros((self._B, self._R), dtype=jnp.int32),
+            "rmin": jnp.full((self._B, self._R), INF, dtype=jnp.int32),
+            "er": jnp.full((self._B, self._R), INF, dtype=jnp.int32),
             "off": jnp.zeros((self._B, self._R), dtype=jnp.int32),
             "act": jnp.zeros((self._B, self._R), dtype=bool),
             "cons": jnp.zeros((self._B, self._C), dtype=jnp.int32),
             "clen": jnp.zeros((self._B,), dtype=jnp.int32),
         }
 
+    def _place(self) -> None:
+        """Re-apply the mesh sharding (if any) after a geometry change —
+        freshly built arrays default to single-device placement."""
+        if self._shardings is not None:
+            self._state = {
+                name: jax.device_put(arr, self._shardings[name])
+                for name, arr in self._state.items()
+            }
+
     def _grow_e(self) -> None:
-        old_w = 2 * self._E + 1
+        """Double the band half-width and replay all branches at the new
+        geometry (band values outside the old window are unknown, so the
+        recorded consensus is re-scanned on device)."""
         self._E *= 2
-        new_w = 2 * self._E + 1
-        pad = (new_w - old_w) // 2
-        d = jnp.full(
-            (self._B, self._R, new_w), NEG, dtype=jnp.int32
-        ).at[:, :, pad : pad + old_w].set(self._state["d"])
-        self._state = dict(self._state, d=d)
+        st = self._state
+        D, e, rmin, er = _j_replay(
+            st["off"], st["act"], st["cons"], st["clen"],
+            self._reads, self._rlen, self._wc, self._et, self._W,
+        )
+        self._state = dict(st, D=D, e=e, rmin=rmin, er=er)
+        self._place()
 
     def _grow_slots(self) -> None:
         old_b = self._B
         self._B *= 2
-        state = self._state
         out = {}
-        for name, arr in state.items():
+        for name, arr in self._state.items():
             shape = (self._B,) + arr.shape[1:]
-            fill = NEG if name == "d" else 0
-            grown = jnp.full(shape, fill, dtype=arr.dtype) if name == "d" else jnp.zeros(shape, dtype=arr.dtype)
+            if name in ("D", "rmin", "er"):
+                grown = jnp.full(shape, INF, dtype=arr.dtype)
+            else:
+                grown = jnp.zeros(shape, dtype=arr.dtype)
             out[name] = grown.at[:old_b].set(arr)
         self._state = out
+        self._place()
         self._free.extend(range(old_b, self._B))
 
     def _grow_cons(self) -> None:
@@ -544,6 +590,7 @@ class JaxScorer(WavefrontScorer):
         self._state = dict(
             self._state, cons=cons.at[:, :old_c].set(self._state["cons"])
         )
+        self._place()
 
     def _alloc(self) -> Tuple[int, int]:
         if not self._free:
@@ -560,7 +607,7 @@ class JaxScorer(WavefrontScorer):
         handle, slot = self._alloc()
         act = np.zeros(self._R, dtype=bool)
         act[: len(active)] = active
-        self._state = _j_root(self._state, slot, jnp.asarray(act))
+        self._state = _j_root(self._state, self._rlen, slot, jnp.asarray(act))
         return handle
 
     def clone(self, h: int) -> int:
@@ -575,26 +622,44 @@ class JaxScorer(WavefrontScorer):
             self._free.append(slot)
 
     def push(self, h: int, consensus: bytes) -> BranchStats:
-        slot = self._slot_of[h]
-        if len(consensus) >= self._C - 1:
-            self._grow_cons()
-        sym = self.sym_id[consensus[-1]]
+        return self.push_many([(h, consensus)])[0]
+
+    def push_many(
+        self, specs: List[Tuple[int, bytes]]
+    ) -> List[BranchStats]:
+        """One fused device dispatch advancing every listed branch by its
+        appended symbol (vmapped over branch slots)."""
+        if not specs:
+            return []
+        for _, consensus in specs:
+            while len(consensus) >= self._C - 1:
+                self._grow_cons()
+        n = len(specs)
+        npad = _next_pow2(n)
+        slots = [self._slot_of[h] for h, _ in specs]
+        syms = [self.sym_id[consensus[-1]] for _, consensus in specs]
+        slots += [slots[0]] * (npad - n)
+        syms += [syms[0]] * (npad - n)
         while True:
-            state, stats, overflow = _j_push(
+            state, stats, overflow = _j_push_batch(
                 self._state,
                 self._reads,
                 self._rlen,
-                slot,
-                jnp.int32(sym),
+                jnp.asarray(slots, dtype=jnp.int32),
+                jnp.asarray(syms, dtype=jnp.int32),
                 self._wc,
                 self._et,
                 self.num_symbols,
             )
+            self._state = state
             if bool(overflow):
                 self._grow_e()
                 continue
-            self._state = state
-            return self._to_host(stats)
+            eds, occ, split, reached = stats
+            return [
+                self._to_host((eds[i], occ[i], split[i], reached[i]))
+                for i in range(n)
+            ]
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
         slot = self._slot_of[h]
@@ -604,7 +669,9 @@ class JaxScorer(WavefrontScorer):
             )
         )
 
-    def activate(self, h: int, read_index: int, offset: int, consensus: bytes) -> None:
+    def activate(
+        self, h: int, read_index: int, offset: int, consensus: bytes
+    ) -> None:
         slot = self._slot_of[h]
         while True:
             state, overflow = _j_activate(
@@ -617,10 +684,10 @@ class JaxScorer(WavefrontScorer):
                 self._wc,
                 self._et,
             )
+            self._state = state
             if bool(overflow):
                 self._grow_e()
                 continue
-            self._state = state
             return
 
     def deactivate(self, h: int, read_index: int) -> None:
@@ -638,7 +705,7 @@ class JaxScorer(WavefrontScorer):
     ) -> Tuple[int, int, bytes]:
         """Device-side unambiguous-run extension; returns
         ``(steps_committed, stop_code, appended_bytes)``.  See ``_j_run``
-        for the stop-code contract; on overflow the bucket is grown so the
+        for the stop-code contract; on overflow the band is grown so the
         caller can simply continue stepping."""
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
@@ -672,9 +739,7 @@ class JaxScorer(WavefrontScorer):
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
         slot = self._slot_of[h]
         while True:
-            eds, overflow = _j_finalize(
-                self._state, self._reads, self._rlen, slot, self._wc
-            )
+            eds, overflow = _j_finalize(self._state, slot)
             if bool(overflow):
                 self._grow_e()
                 continue

@@ -252,7 +252,12 @@ def make_scorer(reads: Sequence[bytes], config: CdwfaConfig) -> WavefrontScorer:
     if config.backend == "jax":
         from waffle_con_tpu.ops.jax_scorer import JaxScorer
 
-        return JaxScorer(reads, config)
+        scorer = JaxScorer(reads, config)
+        if config.mesh_shards:
+            from waffle_con_tpu.parallel import make_mesh, shard_scorer
+
+            shard_scorer(scorer, make_mesh(config.mesh_shards))
+        return scorer
     if config.backend == "native":
         from waffle_con_tpu.native import NativeScorer
 

@@ -1,10 +1,10 @@
-"""Device-mesh parallelism: sharded wavefront steps with collective vote
+"""Device-mesh parallelism: read-sharded scoring with collective vote
 reduction."""
 
 from waffle_con_tpu.parallel.mesh import (
     make_mesh,
-    sharded_branch_step,
-    sharded_consensus_step,
+    shard_scorer,
+    sharded_col_step,
 )
 
-__all__ = ["make_mesh", "sharded_branch_step", "sharded_consensus_step"]
+__all__ = ["make_mesh", "shard_scorer", "sharded_col_step"]

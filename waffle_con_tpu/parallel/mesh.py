@@ -1,29 +1,34 @@
-"""Mesh-parallel wavefront steps.
+"""Mesh-parallel scoring: reads sharded across chips.
 
 The consensus framework has two embarrassingly-parallel axes (SURVEY.md
-§2, parallelism inventory): *reads* (every read's wavefront advances
+§2, parallelism inventory): *reads* (every read's DP column advances
 independently — the data-parallel axis) and *branches* (live search
-hypotheses — a model/batch-parallel axis).  This module maps them onto a
+hypotheses).  This module maps the read axis onto a
 ``jax.sharding.Mesh``:
 
-* reads are sharded across chips; each chip advances its read shard's
-  wavefronts locally (pure VPU work, no communication);
-* the per-step candidate-vote histogram (``[A]`` integer counts), total
-  cost, and reached-end flags are reduced with ``lax.psum`` over the read
-  axis — small fixed-size collectives that ride ICI;
-* branches shard over a second mesh axis with no cross-branch
-  communication at all.
+* :func:`shard_scorer` is the engine-integrated path: it re-places an
+  existing :class:`~waffle_con_tpu.ops.jax_scorer.JaxScorer`'s device
+  state with a ``NamedSharding`` that splits the read axis across the
+  mesh.  Every scorer kernel is a pure jitted function of that state, so
+  XLA's SPMD partitioner runs the column DP shard-locally and inserts
+  all-reduces exactly where the algorithm needs cross-chip data: the
+  per-branch column minima, vote-count sums, and reached/overflow flags.
+  The engines (`ConsensusDWFA`, `DualConsensusDWFA`, ...) run unchanged
+  on 1 or N devices and produce bit-identical results — the host-side
+  fractional-vote arbitration still sees exact integer per-read
+  ``occ``/``split`` tables.
+* :func:`sharded_col_step` is the same column step expressed explicitly
+  with ``shard_map`` + ``psum`` — the hand-written SPMD program, used by
+  the parity tests to pin down the communication pattern (votes/costs
+  reduce like a data-parallel gradient ``psum``; everything else is
+  local VPU work riding ICI-free).
 
-This is the TPU-native equivalent of a distributed communication backend
-for this workload: the only cross-chip traffic the algorithm needs is the
-vote/cost reduction, identical in shape to a gradient ``psum`` in data-
-parallel training.  Multi-host DCN scaling uses the same program — a mesh
-spanning hosts simply makes the ``psum`` cross DCN.
+Multi-host DCN scaling uses the same program: a mesh spanning hosts
+simply makes the same ``psum`` cross DCN.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Sequence
 
 import jax
@@ -32,7 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from waffle_con_tpu.ops.jax_scorer import _stats_row, _update_row
+from waffle_con_tpu.ops.jax_scorer import _col_step, _stats_core
 
 
 def make_mesh(
@@ -47,6 +52,11 @@ def make_mesh(
     """
     devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} mesh devices but only "
+                f"{len(devices)} available"
+            )
         devices = devices[:n_devices]
     arr = np.array(devices)
     if shape is not None:
@@ -58,135 +68,105 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
-def sharded_consensus_step(mesh: Mesh, read_axis: str = "read", num_symbols: int = 32):
-    """Build a jitted data-parallel consensus step for one branch.
+def shard_scorer(scorer, mesh: Mesh, read_axis: str = "read") -> None:
+    """Shard a ``JaxScorer``'s state over the mesh's read axis, in place.
 
-    Returns ``step(d, e, off, act, cons, clen, reads, rlen, sym, wc, et)
-    -> (d', e', votes[num_symbols], ed_total, reached_any, overflow)`` where
-    the per-read state and the reads are sharded over ``read_axis`` and the
-    reductions are ``psum``-ed over it.  ``votes`` are the integer
-    one-tip-symbol read counts; ``ed_total`` is the raw edit-distance sum
-    (apply the L1/L2 cost model on the host).  Dense symbol ids must be
-    < ``num_symbols``.
+    The scorer's padded read count must be divisible by the mesh size
+    (reads are padded to a power of two, so any power-of-two mesh works).
+    After this call every kernel the scorer dispatches is partitioned by
+    GSPMD: column updates run shard-locally, reductions become ICI
+    collectives.  Donated updates preserve the placement, so the state
+    stays sharded for the scorer's lifetime.
+    """
+    n = mesh.devices.size if read_axis not in mesh.shape else mesh.shape[read_axis]
+    if scorer._R % n != 0:
+        raise ValueError(
+            f"padded read count {scorer._R} not divisible by mesh axis {n}"
+        )
+    shardings = {
+        "D": NamedSharding(mesh, P(None, read_axis, None)),
+        "e": NamedSharding(mesh, P(None, read_axis)),
+        "rmin": NamedSharding(mesh, P(None, read_axis)),
+        "er": NamedSharding(mesh, P(None, read_axis)),
+        "off": NamedSharding(mesh, P(None, read_axis)),
+        "act": NamedSharding(mesh, P(None, read_axis)),
+        "cons": NamedSharding(mesh, P(None, None)),
+        "clen": NamedSharding(mesh, P(None)),
+    }
+    scorer._shardings = shardings  # re-applied by the scorer after growth
+    scorer._state = {
+        name: jax.device_put(arr, shardings[name])
+        for name, arr in scorer._state.items()
+    }
+    scorer._reads = jax.device_put(
+        scorer._reads, NamedSharding(mesh, P(read_axis, None))
+    )
+    scorer._rlen = jax.device_put(
+        scorer._rlen, NamedSharding(mesh, P(read_axis))
+    )
+
+
+def sharded_col_step(mesh: Mesh, read_axis: str = "read", num_symbols: int = 32):
+    """Build the explicit shard_map data-parallel column step for one
+    branch.
+
+    Returns ``step(D, e, rmin, er, off, act, cons, clen, reads, rlen,
+    sym, wc, et) -> (D', e', rmin', er', occ, split, total, reached_any,
+    overflow)`` where per-read state and reads are sharded over
+    ``read_axis``; ``occ [R, A]``/``split [R]`` stay sharded (exact
+    integer tip votes per read — the engines' fractional-vote arithmetic
+    needs the full table, not a lossy presence count), while ``total``,
+    ``reached_any`` and ``overflow`` are ``psum``-reduced scalars.
     """
 
-    def body(d, e, off, act, cons, clen, reads, rlen, sym, wc, et):
-        W = d.shape[1]
-        emax = jnp.int32(W // 2)
-        kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
+    def body(D, e, rmin, er, off, act, cons, clen, reads, rlen, sym, wc, et):
+        W = D.shape[1]
+        E = jnp.int32((W - 2) // 2)
         C = cons.shape[0]
-
         cons2 = cons.at[jnp.clip(clen, 0, C - 1)].set(sym)
         clen2 = clen + 1
-        d2, e2, overflow = _update_row(
-            d, e, off, act, cons2, clen2, reads, rlen, wc, et, kvec, emax
+        D2, e2, rmin2, er2 = _col_step(
+            D, e, rmin, er, off, act, rlen, reads, clen2, sym, wc, et, E
         )
-        eds, occ, _split, reached = _stats_row(
-            d2, e2, off, act, cons2, clen2, reads, rlen, num_symbols, kvec
+        eds, occ, split, reached = _stats_core(
+            D2, e2, rmin2, er2, off, act, rlen, reads, clen2, num_symbols, E
         )
-        votes = lax.psum((occ > 0).sum(axis=0), read_axis)
         total = lax.psum(jnp.where(act, eds, 0).sum(), read_axis)
         reached_any = lax.psum(reached.any().astype(jnp.int32), read_axis) > 0
-        overflow = lax.psum(overflow.astype(jnp.int32), read_axis) > 0
-        return d2, e2, votes, total, reached_any, overflow
+        overflow = (
+            lax.psum((act & (e2 >= E)).any().astype(jnp.int32), read_axis) > 0
+        )
+        return D2, e2, rmin2, er2, occ, split, total, reached_any, overflow
 
-    spec_state = P(read_axis, None)
-    spec_read = P(read_axis)
+    rspec = P(read_axis)
+    rwspec = P(read_axis, None)
     sharded = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            spec_state,  # d
-            spec_read,  # e
-            spec_read,  # off
-            spec_read,  # act
+            rwspec,  # D
+            rspec,  # e
+            rspec,  # rmin
+            rspec,  # er
+            rspec,  # off
+            rspec,  # act
             P(None),  # cons
             P(),  # clen
-            spec_state,  # reads
-            spec_read,  # rlen
+            rwspec,  # reads
+            rspec,  # rlen
             P(),  # sym
             P(),  # wc
             P(),  # et
         ),
         out_specs=(
-            spec_state,
-            spec_read,
-            P(None),
+            rwspec,
+            rspec,
+            rspec,
+            rspec,
+            rwspec,  # occ
+            rspec,  # split
             P(),
             P(),
-            P(),
-        ),
-    )
-    return jax.jit(sharded)
-
-
-def sharded_branch_step(mesh: Mesh, branch_axis: str = "branch", read_axis: str = "read", num_symbols: int = 32):
-    """Build the 2D-mesh step: branches × reads.
-
-    State carries a leading branch dimension (``d [B, R, W]`` etc.) and a
-    per-branch consensus/symbol; branches shard over ``branch_axis``
-    (independent, zero communication) while each branch's votes/costs
-    reduce over ``read_axis``.  This is the full multi-chip program shape:
-    dp over reads, branch-parallel over hypotheses, collectives on ICI.
-
-    Returns ``step(d, e, off, act, cons, clen, reads, rlen, syms, wc, et)
-    -> (d', e', votes[B, A], total[B], reached_any[B], overflow)``.
-    """
-
-    def one_branch(d, e, off, act, cons, clen, reads, rlen, sym, wc, et):
-        W = d.shape[1]
-        emax = jnp.int32(W // 2)
-        kvec = jnp.arange(W, dtype=jnp.int32) - W // 2
-        C = cons.shape[0]
-
-        cons2 = cons.at[jnp.clip(clen, 0, C - 1)].set(sym)
-        clen2 = clen + 1
-        d2, e2, overflow = _update_row(
-            d, e, off, act, cons2, clen2, reads, rlen, wc, et, kvec, emax
-        )
-        eds, occ, _split, reached = _stats_row(
-            d2, e2, off, act, cons2, clen2, reads, rlen, num_symbols, kvec
-        )
-        return d2, e2, (occ > 0).sum(axis=0), jnp.where(act, eds, 0).sum(), reached.any(), overflow
-
-    def body(d, e, off, act, cons, clen, reads, rlen, syms, wc, et):
-        d2, e2, local_votes, local_total, local_reached, local_ovf = jax.vmap(
-            one_branch, in_axes=(0, 0, 0, 0, 0, 0, None, None, 0, None, None)
-        )(d, e, off, act, cons, clen, reads, rlen, syms, wc, et)
-        votes = lax.psum(local_votes, read_axis)
-        total = lax.psum(local_total, read_axis)
-        reached = lax.psum(local_reached.astype(jnp.int32), read_axis) > 0
-        overflow = (
-            lax.psum(
-                local_ovf.any().astype(jnp.int32), (branch_axis, read_axis)
-            )
-            > 0
-        )
-        return d2, e2, votes, total, reached, overflow
-
-    bspec = lambda *rest: P(branch_axis, *rest)  # noqa: E731
-    sharded = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(
-            bspec(read_axis, None),  # d
-            bspec(read_axis),  # e
-            bspec(read_axis),  # off
-            bspec(read_axis),  # act
-            bspec(None),  # cons
-            bspec(),  # clen
-            P(read_axis, None),  # reads
-            P(read_axis),  # rlen
-            bspec(),  # syms
-            P(),  # wc
-            P(),  # et
-        ),
-        out_specs=(
-            bspec(read_axis, None),
-            bspec(read_axis),
-            bspec(None),
-            bspec(),
-            bspec(),
             P(),
         ),
     )
