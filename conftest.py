@@ -9,6 +9,8 @@ here works as long as no array op ran yet)."""
 
 import os
 
+import pytest
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,3 +20,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+from waffle_con_tpu.utils.cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Deselect ``slow``-marked tests unless RUN_SLOW=1 is set or the user
+    selected them explicitly with ``-m``."""
+    if os.environ.get("RUN_SLOW") == "1" or config.getoption("-m"):
+        return
+    skip = pytest.mark.skip(reason="slow; set RUN_SLOW=1 or use -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

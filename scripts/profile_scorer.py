@@ -2,12 +2,13 @@
 """Profile the JaxScorer device loop: steps/sec of run_extend, growth
 events, and per-call wall time, at a configurable problem size."""
 
+import pathlib
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from waffle_con_tpu.config import CdwfaConfigBuilder
 from waffle_con_tpu.ops.jax_scorer import JaxScorer
@@ -36,17 +37,31 @@ def main():
         cons += app
         per = dt / max(steps, 1) * 1e3
         print(
-            f"len={len(cons):6d} steps={steps:4d} code={code} E={sc._E:4d} "
-            f"wall={dt:7.3f}s per_step={per:7.3f}ms"
+            f"len={len(cons):6d} steps={steps:4d} code={code} "
+            f"E={sc.bucket_e:4d} wall={dt:7.3f}s per_step={per:7.3f}ms"
         )
-        if code == 2 or (steps == 0 and code not in (4, 5)):
+        if code == 2:
+            break
+        if code == 1:
+            # votes need host arbitration: resolve by pushing the plurality
+            # symbol so the profile covers the configured length, not just
+            # the unambiguous prefix
+            stats = sc.stats(h, cons)
+            votes = stats.occ.sum(axis=0)
+            if votes.sum() == 0:
+                print("no candidates; stopping")
+                break
+            sym = int(sc.symtab[int(np.argmax(votes))])
+            cons += bytes([sym])
+            sc.push(h, cons)
+        elif steps == 0 and code not in (4, 5):
             break
         if len(cons) > L + 200:
             break
     total = time.perf_counter() - t_all
     print(
         f"TOTAL: {total:.2f}s for {len(cons)} symbols in {calls} calls "
-        f"({total/max(len(cons),1)*1e3:.3f} ms/symbol), final E={sc._E}"
+        f"({total/max(len(cons),1)*1e3:.3f} ms/symbol), final E={sc.bucket_e}"
     )
 
 

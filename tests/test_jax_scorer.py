@@ -176,7 +176,6 @@ def test_dual_engine_backend_parity_small():
         assert a.consensus1.scores == b.consensus1.scores
 
 
-@pytest.mark.slow
 def test_dual_engine_backend_parity_fixture():
     from waffle_con_tpu import ConsensusCost
 
@@ -198,3 +197,45 @@ def test_dual_engine_backend_parity_fixture():
         assert a.scores1 == b.scores1
         assert a.scores2 == b.scores2
         assert a.consensus1.scores == b.consensus1.scores
+
+
+def _run_priority_fixture_jax(name):
+    from waffle_con_tpu import PriorityConsensusDWFA
+    from waffle_con_tpu.utils.fixtures import load_priority_fixture
+
+    config = CdwfaConfigBuilder().wildcard(ord("*")).backend("jax").build()
+    chains, expected = load_priority_fixture(name, True, config.consensus_cost)
+    engine = PriorityConsensusDWFA(config)
+    for chain in chains:
+        engine.add_sequence_chain(chain)
+    result = engine.consensus()
+    assert result.sequence_indices == expected.sequence_indices
+    assert len(result.consensuses) == len(expected.consensuses)
+    for got_chain, want_chain in zip(result.consensuses, expected.consensuses):
+        for got, want in zip(got_chain, want_chain):
+            assert got.sequence == want.sequence
+
+
+def test_priority_engine_jax_backend_fixture():
+    """priority_001 through the full priority → dual → jax-scorer stack."""
+    _run_priority_fixture_jax("priority_001")
+
+
+def test_multi_err_recovery_jax_backend():
+    """multi_err_001 (consensus must be *recovered*, not present verbatim)
+    through the priority engine on the jax backend."""
+    from waffle_con_tpu import PriorityConsensusDWFA
+    from waffle_con_tpu.utils.fixtures import load_priority_fixture
+
+    config = CdwfaConfigBuilder().wildcard(ord("*")).backend("jax").build()
+    chains, expected = load_priority_fixture(
+        "multi_err_001", False, config.consensus_cost
+    )
+    engine = PriorityConsensusDWFA(config)
+    for chain in chains:
+        engine.add_sequence_chain(chain)
+    result = engine.consensus()
+    assert result.sequence_indices == expected.sequence_indices
+    for got_chain, want_chain in zip(result.consensuses, expected.consensuses):
+        for got, want in zip(got_chain, want_chain):
+            assert got.sequence == want.sequence

@@ -46,6 +46,13 @@ class EngineError(Exception):
     ``/root/reference/src/consensus.rs:850``)."""
 
 
+def check_invariant(condition: bool, message: str) -> None:
+    """Engine invariant check that survives ``python -O`` (unlike
+    ``assert``): violations raise :class:`EngineError`."""
+    if not condition:
+        raise EngineError(f"internal invariant violated: {message}")
+
+
 class Consensus:
     """A final consensus result: the sequence, the cost model, and the
     per-read scores (parity with ``/root/reference/src/consensus.rs:42-74``)."""
@@ -425,7 +432,7 @@ class ConsensusDWFA:
                     tracker.remove(len(child.consensus))
                     scorer.free(child.handle)
 
-        assert len(tracker) == 0
+        check_invariant(len(tracker) == 0, "tracker drained at search end")
 
         results.sort(key=lambda c: c.sequence)
         logger.debug("nodes_explored: %d", nodes_explored)
@@ -445,7 +452,7 @@ class ConsensusDWFA:
     def _activate(
         self, scorer: WavefrontScorer, node: _Node, seq_index: int
     ) -> None:
-        assert not node.active[seq_index]
+        check_invariant(not node.active[seq_index], "activating an already-active read")
         cfg = self.config
         offset = find_activation_offset(
             node.consensus,

@@ -23,6 +23,7 @@ from waffle_con_tpu.models.consensus import (
     EngineError,
     candidates_from_stats,
     shift_offsets,
+    check_invariant,
 )
 from waffle_con_tpu.ops.scorer import (
     WavefrontScorer,
@@ -361,7 +362,7 @@ class DualConsensusDWFA:
                 threshold_cutoff = single_tracker.threshold()
                 at_capacity = single_tracker.at_capacity(top_len)
 
-            assert top_len < len(active_min_count)
+            check_invariant(top_len < len(active_min_count), "active_min_count covers popped length")
             if (
                 top_cost > maximum_error
                 or top_len < threshold_cutoff
@@ -428,10 +429,14 @@ class DualConsensusDWFA:
             )
             self._free_node(scorer, node)
 
-            assert len(pqueue) == single_tracker.unfiltered_len() + dual_tracker.unfiltered_len()
+            check_invariant(
+                len(pqueue)
+                == single_tracker.unfiltered_len() + dual_tracker.unfiltered_len(),
+                "queue and trackers in sync",
+            )
 
-        assert len(single_tracker) == 0
-        assert len(dual_tracker) == 0
+        check_invariant(len(single_tracker) == 0, "single tracker drained")
+        check_invariant(len(dual_tracker) == 0, "dual tracker drained")
 
         if len(results) > 1:
             results.sort(
@@ -470,51 +475,6 @@ class DualConsensusDWFA:
             scorer.free(node.h2)
         node.h1 = node.h2 = None
 
-    def _clone_node(self, scorer: WavefrontScorer, node: _DualNode) -> _DualNode:
-        child = _DualNode()
-        child.is_dual = node.is_dual
-        child.lock1 = node.lock1
-        child.lock2 = node.lock2
-        child.consensus1 = node.consensus1
-        child.consensus2 = node.consensus2
-        child.h1 = scorer.clone(node.h1)
-        child.h2 = scorer.clone(node.h2) if node.h2 is not None else None
-        child.active1 = list(node.active1)
-        child.active2 = list(node.active2)
-        child.offsets1 = list(node.offsets1)
-        child.offsets2 = list(node.offsets2)
-        child.stats1 = node.stats1
-        child.stats2 = node.stats2
-        return child
-
-    def _push_side(self, scorer, node: _DualNode, symbol: int, side1: bool) -> None:
-        if side1:
-            if node.lock1:
-                raise EngineError("Consensus 1 is locked, cannot modify")
-            node.consensus1 = node.consensus1 + bytes([symbol])
-            node.stats1 = scorer.push(node.h1, node.consensus1)
-        else:
-            if node.lock2:
-                raise EngineError("Consensus 2 is locked, cannot modify")
-            node.consensus2 = node.consensus2 + bytes([symbol])
-            node.stats2 = scorer.push(node.h2, node.consensus2)
-
-    def _activate_dual(
-        self, scorer, node: _DualNode, symbol1: int, symbol2: int
-    ) -> None:
-        """Split a non-dual node in two, extending the copies with the two
-        competing symbols (``/root/reference/src/dual_consensus.rs:957-976``)."""
-        assert not node.is_dual
-        assert symbol1 != symbol2
-        node.is_dual = True
-        node.consensus2 = node.consensus1
-        node.h2 = scorer.clone(node.h1)
-        node.active2 = list(node.active1)
-        node.offsets2 = list(node.offsets1)
-        node.stats2 = node.stats1
-        self._push_side(scorer, node, symbol1, True)
-        self._push_side(scorer, node, symbol2, False)
-
     def _activate_sequence(self, scorer, node: _DualNode, seq_index: int) -> None:
         cfg = self.config
         sides = [(True, node.consensus1)]
@@ -522,7 +482,7 @@ class DualConsensusDWFA:
             sides.append((False, node.consensus2))
         for side1, consensus in sides:
             active = node.active1 if side1 else node.active2
-            assert not active[seq_index]
+            check_invariant(not active[seq_index], "activating an already-active read")
             offset = find_activation_offset(
                 consensus,
                 self.sequences[seq_index],
@@ -549,9 +509,12 @@ class DualConsensusDWFA:
             for seq_index in activate_list:
                 self._activate_sequence(scorer, node, seq_index)
 
-    def _prune_dwfa(self, scorer, node: _DualNode, ed_delta: int) -> None:
+    def _collect_prune(
+        self, node: _DualNode, ed_delta: int, deactivations: List[Tuple[int, int]]
+    ) -> None:
         """Drop the clearly-worse wavefront of a read tracked on both sides
-        (``/root/reference/src/dual_consensus.rs:1030-1045``)."""
+        (``/root/reference/src/dual_consensus.rs:1030-1045``); the scorer
+        deactivations are collected for one batched dispatch."""
         if not node.is_dual:
             return
         for r in range(len(node.active1)):
@@ -559,11 +522,11 @@ class DualConsensusDWFA:
                 e1 = int(node.stats1.eds[r])
                 e2 = int(node.stats2.eds[r])
                 if e1 + ed_delta < e2:
-                    scorer.deactivate(node.h2, r)
+                    deactivations.append((node.h2, r))
                     node.active2[r] = False
                     node.offsets2[r] = None
                 elif e2 + ed_delta < e1:
-                    scorer.deactivate(node.h1, r)
+                    deactivations.append((node.h1, r))
                     node.active1[r] = False
                     node.offsets1[r] = None
 
@@ -659,6 +622,8 @@ class DualConsensusDWFA:
         max_observed1 = max(ec1.values(), default=float(min_count1))
         active_threshold1 = min(float(min_count1), max_observed1)
 
+        # -- phase 1: decide every child as a (kind, sym1, sym2) spec ----
+        specs: List[Tuple[str, Optional[int], Optional[int]]] = []
         if node.is_dual:
             ec2 = node.candidates(False, scorer.symtab, wildcard, weighted)
             min_count2 = max(
@@ -694,36 +659,21 @@ class DualConsensusDWFA:
                     if ec2[sym] >= active_threshold2
                 )
 
-            assert opt_ec1 and opt_ec2
+            check_invariant(bool(opt_ec1 and opt_ec2), "dual extension option sets non-empty")
 
-            for can1 in opt_ec1:
-                for can2 in opt_ec2:
-                    if can1 is None and can2 is None:
-                        continue  # extending neither would duplicate the node
-                    child = self._clone_node(scorer, node)
-                    if can1 is not None:
-                        self._push_side(scorer, child, can1, True)
-                    else:
-                        child.lock1 = True
-                    if can2 is not None:
-                        self._push_side(scorer, child, can2, False)
-                    else:
-                        child.lock2 = True
-                    self._maybe_activate(scorer, child, activate_points)
-                    self._prune_dwfa(scorer, child, cfg.dual_max_ed_delta)
-                    assert child.is_dual
-                    self._queue_child(pqueue, dual_tracker, scorer, child, cost)
+            specs.extend(
+                ("dual", can1, can2)
+                for can1 in opt_ec1
+                for can2 in opt_ec2
+                # extending neither would duplicate the node
+                if not (can1 is None and can2 is None)
+            )
         else:
-            # stay non-dual: one child per passing symbol
-            for sym in sorted(ec1):
-                if ec1[sym] < active_threshold1:
-                    continue
-                child = self._clone_node(scorer, node)
-                self._push_side(scorer, child, sym, True)
-                self._maybe_activate(scorer, child, activate_points)
-                assert not child.is_dual
-                self._queue_child(pqueue, single_tracker, scorer, child, cost)
-
+            specs.extend(
+                ("single", sym, None)
+                for sym in sorted(ec1)
+                if ec1[sym] >= active_threshold1
+            )
             # dual-split generation: every unordered pair of distinct
             # non-wildcard candidates, when at least two meet min_count1
             sorted_candidates = sorted(
@@ -733,11 +683,107 @@ class DualConsensusDWFA:
                 1 for negc, _sym in sorted_candidates if -negc >= min_count1
             )
             if num_passing > 1:
-                for i, (_nc1, c1) in enumerate(sorted_candidates):
-                    for _nc2, c2 in sorted_candidates[i + 1 :]:
-                        child = self._clone_node(scorer, node)
-                        self._activate_dual(scorer, child, c1, c2)
-                        self._maybe_activate(scorer, child, activate_points)
-                        self._prune_dwfa(scorer, child, cfg.dual_max_ed_delta)
-                        assert child.is_dual
-                        self._queue_child(pqueue, dual_tracker, scorer, child, cost)
+                specs.extend(
+                    ("split", c1, c2)
+                    for i, (_nc1, c1) in enumerate(sorted_candidates)
+                    for _nc2, c2 in sorted_candidates[i + 1 :]
+                )
+        if not specs:
+            return
+
+        # -- phase 2: one fused clone dispatch for every child branch ----
+        clone_srcs: List[int] = []
+        for kind, _a, _b in specs:
+            if kind == "dual":
+                clone_srcs += [node.h1, node.h2]
+            elif kind == "single":
+                clone_srcs += [node.h1]
+            else:  # split: both sides start from consensus1's state
+                clone_srcs += [node.h1, node.h1]
+        handles = scorer.clone_many(clone_srcs)
+
+        # -- phase 3: build children; one fused push dispatch ------------
+        children: List[_DualNode] = []
+        push_specs: List[Tuple[int, bytes]] = []
+        push_targets: List[Tuple[int, bool]] = []
+        hi = 0
+
+        def queue_push(ci: int, child: _DualNode, sym: int, side1: bool) -> None:
+            if side1:
+                if child.lock1:
+                    raise EngineError("Consensus 1 is locked, cannot modify")
+                child.consensus1 = child.consensus1 + bytes([sym])
+                push_specs.append((child.h1, child.consensus1))
+            else:
+                if child.lock2:
+                    raise EngineError("Consensus 2 is locked, cannot modify")
+                child.consensus2 = child.consensus2 + bytes([sym])
+                push_specs.append((child.h2, child.consensus2))
+            push_targets.append((ci, side1))
+
+        for ci, (kind, a, b) in enumerate(specs):
+            child = _DualNode()
+            child.consensus1 = node.consensus1
+            child.active1 = list(node.active1)
+            child.offsets1 = list(node.offsets1)
+            child.stats1 = node.stats1
+            if kind == "dual":
+                child.is_dual = True
+                child.lock1 = node.lock1
+                child.lock2 = node.lock2
+                child.h1, child.h2 = handles[hi], handles[hi + 1]
+                hi += 2
+                child.consensus2 = node.consensus2
+                child.active2 = list(node.active2)
+                child.offsets2 = list(node.offsets2)
+                child.stats2 = node.stats2
+                if a is not None:
+                    queue_push(ci, child, a, True)
+                else:
+                    child.lock1 = True
+                if b is not None:
+                    queue_push(ci, child, b, False)
+                else:
+                    child.lock2 = True
+            elif kind == "single":
+                child.h1 = handles[hi]
+                hi += 1
+                child.consensus2 = node.consensus2
+                child.active2 = list(node.active2)
+                child.offsets2 = list(node.offsets2)
+                queue_push(ci, child, a, True)
+            else:  # split (/root/reference/src/dual_consensus.rs:957-976)
+                check_invariant(a != b, "dual split needs distinct symbols")
+                child.is_dual = True
+                child.h1, child.h2 = handles[hi], handles[hi + 1]
+                hi += 2
+                child.consensus2 = node.consensus1
+                child.active2 = list(node.active1)
+                child.offsets2 = list(node.offsets1)
+                child.stats2 = node.stats1
+                queue_push(ci, child, a, True)
+                queue_push(ci, child, b, False)
+            children.append(child)
+
+        for (ci, side1), stats in zip(
+            push_targets, scorer.push_many(push_specs)
+        ):
+            if side1:
+                children[ci].stats1 = stats
+            else:
+                children[ci].stats2 = stats
+
+        # -- phase 4: activations, batched pruning, queueing -------------
+        deactivations: List[Tuple[int, int]] = []
+        for child in children:
+            self._maybe_activate(scorer, child, activate_points)
+            self._collect_prune(child, cfg.dual_max_ed_delta, deactivations)
+        scorer.deactivate_many(deactivations)
+
+        for (kind, _a, _b), child in zip(specs, children):
+            if kind == "single":
+                check_invariant(not child.is_dual, "single child stays single")
+                self._queue_child(pqueue, single_tracker, scorer, child, cost)
+            else:
+                check_invariant(child.is_dual, "dual child stays dual")
+                self._queue_child(pqueue, dual_tracker, scorer, child, cost)

@@ -15,7 +15,11 @@ import logging
 from typing import List, Optional, Set, Tuple
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
-from waffle_con_tpu.models.consensus import Consensus, EngineError
+from waffle_con_tpu.models.consensus import (
+    Consensus,
+    EngineError,
+    check_invariant,
+)
 from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
 
 logger = logging.getLogger(__name__)
@@ -160,7 +164,7 @@ class PriorityConsensusDWFA:
                         else:
                             assign2[i] = True
                         ic_index += 1
-                assert ic_index == len(is_c1)
+                check_invariant(ic_index == len(is_c1), "assignment vector fully consumed")
 
                 to_split.append(assign1)
                 split_levels.append(current_split_level)
@@ -189,7 +193,7 @@ class PriorityConsensusDWFA:
             for con_index, old_index in enumerate(order):
                 for i, assigned in enumerate(assignments[old_index]):
                     if assigned:
-                        assert indices[i] == -1
+                        check_invariant(indices[i] == -1, "sequence index remapped once")
                         indices[i] = con_index
                 sorted_cons.append(consensuses[old_index])
             return PriorityConsensus(sorted_cons, indices)

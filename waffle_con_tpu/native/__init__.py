@@ -45,7 +45,6 @@ def _build() -> None:
     cmd = [
         "g++",
         "-O3",
-        "-march=native",
         "-std=c++17",
         "-shared",
         "-fPIC",

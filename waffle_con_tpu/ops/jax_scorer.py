@@ -180,9 +180,26 @@ def _j_clone(state, src, dst):
 
 
 @partial(jax.jit, donate_argnums=(0,))
+def _j_clone_batch(state, srcs, dsts):
+    """Copy a batch of branch slots (``dsts`` padded with repeats of
+    ``dsts[0]`` are fine: duplicate writes carry identical rows)."""
+    out = dict(state)
+    for name in ("D", "e", "rmin", "er", "off", "act", "cons", "clen"):
+        out[name] = state[name].at[dsts].set(state[name][srcs])
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
 def _j_deactivate(state, h, read_index):
     out = dict(state)
     out["act"] = state["act"].at[h, read_index].set(False)
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _j_deactivate_batch(state, hs, ridx):
+    out = dict(state)
+    out["act"] = state["act"].at[hs, ridx].set(False)
     return out
 
 
@@ -531,6 +548,11 @@ class JaxScorer(WavefrontScorer):
     # -- geometry ------------------------------------------------------
 
     @property
+    def bucket_e(self) -> int:
+        """Current band half-width (diagnostics; grows geometrically)."""
+        return self._E
+
+    @property
     def _W(self) -> int:
         return 2 * self._E + 2
 
@@ -616,6 +638,24 @@ class JaxScorer(WavefrontScorer):
         self._state = _j_clone(self._state, src, dst)
         return handle
 
+    def clone_many(self, hs: List[int]) -> List[int]:
+        """One fused scatter-copy for a batch of branch clones."""
+        if not hs:
+            return []
+        srcs = [self._slot_of[h] for h in hs]
+        alloc = [self._alloc() for _ in hs]
+        handles = [a[0] for a in alloc]
+        dsts = [a[1] for a in alloc]
+        npad = _next_pow2(len(hs))
+        srcs += [srcs[0]] * (npad - len(hs))
+        dsts += [dsts[0]] * (npad - len(hs))
+        self._state = _j_clone_batch(
+            self._state,
+            jnp.asarray(srcs, dtype=jnp.int32),
+            jnp.asarray(dsts, dtype=jnp.int32),
+        )
+        return handles
+
     def free(self, h: int) -> None:
         slot = self._slot_of.pop(h, None)
         if slot is not None:
@@ -693,6 +733,20 @@ class JaxScorer(WavefrontScorer):
     def deactivate(self, h: int, read_index: int) -> None:
         slot = self._slot_of[h]
         self._state = _j_deactivate(self._state, slot, jnp.int32(read_index))
+
+    def deactivate_many(self, pairs) -> None:
+        if not pairs:
+            return
+        npad = _next_pow2(len(pairs))
+        hs = [self._slot_of[h] for h, _ in pairs]
+        ridx = [r for _, r in pairs]
+        hs += [hs[0]] * (npad - len(pairs))
+        ridx += [ridx[0]] * (npad - len(pairs))
+        self._state = _j_deactivate_batch(
+            self._state,
+            jnp.asarray(hs, dtype=jnp.int32),
+            jnp.asarray(ridx, dtype=jnp.int32),
+        )
 
     def run_extend(
         self,

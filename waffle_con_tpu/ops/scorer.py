@@ -123,6 +123,11 @@ class WavefrontScorer:
     def clone(self, h: int) -> int:
         raise NotImplementedError
 
+    def clone_many(self, hs: List[int]) -> List[int]:
+        """Batched :meth:`clone`; backends override to fuse into one
+        device call."""
+        return [self.clone(h) for h in hs]
+
     def free(self, h: int) -> None:
         raise NotImplementedError
 
@@ -151,6 +156,11 @@ class WavefrontScorer:
     def deactivate(self, h: int, read_index: int) -> None:
         """Stop tracking a read (dual-mode divergence pruning)."""
         raise NotImplementedError
+
+    def deactivate_many(self, pairs: List[Tuple[int, int]]) -> None:
+        """Batched :meth:`deactivate` over ``(handle, read_index)`` pairs."""
+        for h, read_index in pairs:
+            self.deactivate(h, read_index)
 
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
         """Edit distances after forcing every tracked read's wavefront to

@@ -1,0 +1,28 @@
+"""Persistent XLA compilation cache.
+
+The scorer kernels recompile per geometry (band width, slot count, read
+count); the cache makes those compiles one-time per machine rather than
+per process — important on TPU where a single compile can take tens of
+seconds."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at ``path`` (default
+    ``$JAX_CACHE_DIR`` or ``~/.cache/waffle_con_tpu_jax``).  Safe to call
+    multiple times."""
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "JAX_CACHE_DIR",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "waffle_con_tpu_jax"
+            ),
+        )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
