@@ -98,6 +98,16 @@ def load_library() -> ctypes.CDLL:
             _U8P, _I64P, _I64, _I64P, _I64P, ctypes.c_double,
             ctypes.POINTER(_U8P), _I64P,
         ]
+        lib.wn_dual_consensus.restype = ctypes.c_int
+        lib.wn_dual_consensus.argtypes = [
+            _U8P, _I64P, _I64, _I64P, _I64P, ctypes.c_double,
+            ctypes.POINTER(_U8P), _I64P,
+        ]
+        lib.wn_priority_consensus.restype = ctypes.c_int
+        lib.wn_priority_consensus.argtypes = [
+            _U8P, _I64P, _I64, _I64, _I64P, _I64P, _I64P, ctypes.c_double,
+            ctypes.POINTER(_U8P), _I64P,
+        ]
         lib.wn_blob_free.argtypes = [_U8P]
         _lib = lib
         return lib
@@ -211,7 +221,194 @@ def native_wfa_ed(
 _ENGINE_ERRORS = {
     1: "Must have at least one initial offset of None to see the consensus.",
     3: "Finalize called on DWFA that was never initialized.",
+    4: "internal invariant violated: activating an already-active read",
 }
+
+
+class _BlobReader:
+    def __init__(self, raw: bytes) -> None:
+        self.raw = raw
+        self.pos = 0
+
+    def i64(self) -> int:
+        (v,) = struct.unpack_from("<q", self.raw, self.pos)
+        self.pos += 8
+        return v
+
+    def data(self) -> bytes:
+        n = self.i64()
+        out = self.raw[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def vec(self) -> List[int]:
+        return [self.i64() for _ in range(self.i64())]
+
+
+def _int_cfg_base(cfg: CdwfaConfig) -> List[int]:
+    return [
+        1 if cfg.consensus_cost is ConsensusCost.L2_DISTANCE else 0,
+        cfg.max_queue_size,
+        cfg.max_capacity_per_size,
+        cfg.max_return_size,
+        cfg.max_nodes_wo_constraint,
+        cfg.min_count,
+        -1 if cfg.wildcard is None else cfg.wildcard,
+        1 if cfg.allow_early_termination else 0,
+        1 if cfg.auto_shift_offsets else 0,
+        cfg.offset_window,
+        cfg.offset_compare_length,
+    ]
+
+
+def _int_cfg_dual(cfg: CdwfaConfig) -> np.ndarray:
+    return np.array(
+        _int_cfg_base(cfg)
+        + [1 if cfg.weighted_by_ed else 0, cfg.dual_max_ed_delta],
+        dtype=np.int64,
+    )
+
+
+def _check_offsets(offsets, n: int, what: str = "offsets"):
+    from waffle_con_tpu.models.consensus import EngineError
+
+    if len(offsets) != n:
+        raise EngineError(
+            f"{what} must have one entry per sequence "
+            f"({len(offsets)} != {n})"
+        )
+
+
+def _call_blob(fn, *args):
+    """Invoke a blob-returning engine entry; raises EngineError on rc != 0."""
+    from waffle_con_tpu.models.consensus import EngineError
+
+    lib = load_library()
+    blob = _U8P()
+    size = _I64(0)
+    rc = fn(lib, *args, ctypes.byref(blob), ctypes.byref(size))
+    if rc != 0:
+        raise EngineError(_ENGINE_ERRORS.get(rc, f"native engine error {rc}"))
+    try:
+        return ctypes.string_at(blob, size.value)
+    finally:
+        lib.wn_blob_free(blob)
+
+
+def _read_dual_results(reader: "_BlobReader", cost: ConsensusCost):
+    """Decode the dual-result blob into DualConsensus objects."""
+    from waffle_con_tpu.models.consensus import Consensus
+    from waffle_con_tpu.models.dual_consensus import DualConsensus
+
+    results = []
+    n_results = reader.i64()
+    for _ in range(n_results):
+        cons1 = reader.data()
+        has2 = reader.i64()
+        cons2 = reader.data() if has2 else None
+        n = reader.i64()
+        is_cons1 = [bool(reader.i64()) for _ in range(n)]
+        scores1 = [None if v < 0 else v for v in reader.vec()]
+        scores2 = [None if v < 0 else v for v in reader.vec()]
+        c1_scores = reader.vec()
+        c2_scores = reader.vec()
+        c1 = Consensus(cons1, cost, c1_scores)
+        c2 = Consensus(cons2, cost, c2_scores) if has2 else None
+        results.append(
+            DualConsensus(c1, c2, is_cons1, scores1, scores2)
+        )
+    return results
+
+
+def native_dual_consensus(
+    reads: Sequence[bytes],
+    offsets: Optional[Sequence[Optional[int]]] = None,
+    config: Optional[CdwfaConfig] = None,
+):
+    """Run the full C++ dual-consensus engine; returns the same
+    ``List[DualConsensus]`` the Python/JAX engines produce."""
+    cfg = config if config is not None else CdwfaConfig()
+    if offsets is None:
+        offsets = [None] * len(reads)
+    _check_offsets(offsets, len(reads))
+    data_ptr, lens_ptr, _keep = _pack_reads([bytes(r) for r in reads])
+    offs = np.array([-1 if o is None else o for o in offsets], dtype=np.int64)
+    int_cfg = _int_cfg_dual(cfg)
+
+    raw = _call_blob(
+        lambda lib, *a: lib.wn_dual_consensus(*a),
+        data_ptr, lens_ptr, len(reads), offs.ctypes.data_as(_I64P),
+        int_cfg.ctypes.data_as(_I64P), cfg.min_af,
+    )
+    return _read_dual_results(_BlobReader(raw), cfg.consensus_cost)
+
+
+def native_priority_consensus(
+    chains: Sequence[Sequence[bytes]],
+    offsets: Optional[Sequence[Sequence[Optional[int]]]] = None,
+    seed_groups: Optional[Sequence[Optional[int]]] = None,
+    config: Optional[CdwfaConfig] = None,
+):
+    """Run the full C++ priority (chained multi) consensus engine; returns
+    the same ``PriorityConsensus`` the Python engine produces."""
+    from waffle_con_tpu.models.consensus import Consensus, EngineError
+    from waffle_con_tpu.models.priority_consensus import PriorityConsensus
+
+    cfg = config if config is not None else CdwfaConfig()
+    if not chains:
+        raise EngineError("Must provide a non-empty sequences Vec")
+    n_levels = len(chains[0])
+    if n_levels == 0:
+        raise EngineError("Must provide a non-empty sequences Vec")
+    for chain in chains:
+        if len(chain) != n_levels:
+            raise EngineError(
+                f"Expected sequences Vec of length {n_levels}, "
+                f"but got one of length {len(chain)}"
+            )
+    if offsets is None:
+        offsets = [[None] * n_levels for _ in chains]
+    if seed_groups is None:
+        seed_groups = [None] * len(chains)
+    _check_offsets(offsets, len(chains), "offset chains")
+    for offset_chain in offsets:
+        _check_offsets(offset_chain, n_levels, "offset chain levels")
+    _check_offsets(seed_groups, len(chains), "seed_groups")
+
+    flat = b"".join(bytes(s) for chain in chains for s in chain)
+    lens = np.array(
+        [len(s) for chain in chains for s in chain], dtype=np.int64
+    )
+    offs = np.array(
+        [
+            -1 if o is None else o
+            for offset_chain in offsets
+            for o in offset_chain
+        ],
+        dtype=np.int64,
+    )
+    seeds = np.array(
+        [-1 if s is None else s for s in seed_groups], dtype=np.int64
+    )
+    int_cfg = _int_cfg_dual(cfg)
+
+    raw = _call_blob(
+        lambda lib, *a: lib.wn_priority_consensus(*a),
+        _bytes_ptr(flat), lens.ctypes.data_as(_I64P), len(chains), n_levels,
+        offs.ctypes.data_as(_I64P), seeds.ctypes.data_as(_I64P),
+        int_cfg.ctypes.data_as(_I64P), cfg.min_af,
+    )
+    reader = _BlobReader(raw)
+    out_chains = []
+    for _ in range(reader.i64()):
+        chain = []
+        for _ in range(reader.i64()):
+            seq = reader.data()
+            scores = reader.vec()
+            chain.append(Consensus(seq, cfg.consensus_cost, scores))
+        out_chains.append(chain)
+    indices = reader.vec()
+    return PriorityConsensus(out_chains, indices)
 
 
 def native_consensus(
@@ -226,58 +423,27 @@ def native_consensus(
     cfg = config if config is not None else CdwfaConfig()
     if offsets is None:
         offsets = [None] * len(reads)
-    lib = load_library()
+    _check_offsets(offsets, len(reads))
     data_ptr, lens_ptr, _keep = _pack_reads([bytes(r) for r in reads])
     offs = np.array(
         [-1 if o is None else o for o in offsets], dtype=np.int64
     )
-    int_cfg = np.array(
-        [
-            1 if cfg.consensus_cost is ConsensusCost.L2_DISTANCE else 0,
-            cfg.max_queue_size,
-            cfg.max_capacity_per_size,
-            cfg.max_return_size,
-            cfg.max_nodes_wo_constraint,
-            cfg.min_count,
-            -1 if cfg.wildcard is None else cfg.wildcard,
-            1 if cfg.allow_early_termination else 0,
-            1 if cfg.auto_shift_offsets else 0,
-            cfg.offset_window,
-            cfg.offset_compare_length,
-        ],
-        dtype=np.int64,
-    )
-    blob = _U8P()
-    size = _I64(0)
-    rc = lib.wn_consensus(
-        data_ptr, lens_ptr, len(reads), offs.ctypes.data_as(_I64P),
-        int_cfg.ctypes.data_as(_I64P), cfg.min_af,
-        ctypes.byref(blob), ctypes.byref(size),
-    )
-    if rc != 0:
-        if rc == 2:
-            raise EngineError("Encountered coverage gap")
-        raise EngineError(_ENGINE_ERRORS.get(rc, f"native engine error {rc}"))
+    int_cfg = np.array(_int_cfg_base(cfg), dtype=np.int64)
     try:
-        raw = ctypes.string_at(blob, size.value)
-    finally:
-        lib.wn_blob_free(blob)
+        raw = _call_blob(
+            lambda lib, *a: lib.wn_consensus(*a),
+            data_ptr, lens_ptr, len(reads), offs.ctypes.data_as(_I64P),
+            int_cfg.ctypes.data_as(_I64P), cfg.min_af,
+        )
+    except EngineError as exc:
+        if "native engine error 2" in str(exc):
+            raise EngineError("Encountered coverage gap") from None
+        raise
 
+    reader = _BlobReader(raw)
     results = []
-    pos = 0
-
-    def read_i64():
-        nonlocal pos
-        (v,) = struct.unpack_from("<q", raw, pos)
-        pos += 8
-        return v
-
-    n_results = read_i64()
-    for _ in range(n_results):
-        seq_len = read_i64()
-        sequence = raw[pos : pos + seq_len]
-        pos += seq_len
-        n_scores = read_i64()
-        scores = [read_i64() for _ in range(n_scores)]
+    for _ in range(reader.i64()):
+        sequence = reader.data()
+        scores = reader.vec()
         results.append((sequence, scores))
     return results
