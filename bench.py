@@ -1,18 +1,29 @@
 #!/usr/bin/env python
-"""North-star benchmark: single-consensus wall clock, TPU engine vs the
-native C++ CPU engine (the reference-equivalent baseline; the reference
-publishes no numbers — BASELINE.md).
+"""Benchmarks: TPU engine vs the native C++ CPU engines (the
+reference-equivalent baselines; the reference publishes no numbers —
+BASELINE.md).
 
-Default config: 256 reads × 10 kb at 1% error (HiFi-like), alphabet 4,
-min_count = reads/4 — the BASELINE.json north-star point.  Smoke mode
-(``BENCH_SMOKE=1``) shrinks to 16×1000 for quick validation.
+Default mode prints exactly ONE JSON line for the north-star config —
+256 reads x 10 kb at 1% error (HiFi-like), alphabet 4, min_count =
+reads/4 — with a ``breakdown`` object (device dispatch counts, run-extend
+steps, band growth events, host/device wall split) and a five-scenario
+parity gate (single, errored, dual split, multi split, priority chains,
+per BASELINE.md).  ``vs_baseline`` > 1 is a speedup over the CPU
+baseline.
 
-Prints exactly one JSON line:
-``{"metric": ..., "value": <tpu seconds>, "unit": "s",
-   "vs_baseline": <cpu_time / tpu_time>, ...}``
-so ``vs_baseline`` > 1 is a speedup over the CPU baseline.
+Other modes (one JSON line per config):
+  --grid      the reference criterion grid
+              (``/root/reference/benches/consensus_bench.rs:9-33``):
+              seq_len {1000, 10000} x num_samples {8, 30} x error
+              {0.0, 0.01, 0.02}, alphabet 4, min_count = ns/4.
+  --dual      dual-engine north-star point (two haplotypes).
+  --priority  priority-chain north-star point.
+  --smoke     16x1000 quick validation (also via BENCH_SMOKE=1).
+
+``--trace DIR`` wraps the timed run in a ``jax.profiler`` trace.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -21,17 +32,71 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def run() -> None:
-    from waffle_con_tpu import CdwfaConfigBuilder, ConsensusDWFA
+def _make_engine(kind, cfg, reads_or_chains):
+    from waffle_con_tpu import (
+        ConsensusDWFA,
+        DualConsensusDWFA,
+        PriorityConsensusDWFA,
+    )
+
+    engine = {
+        "single": ConsensusDWFA,
+        "dual": DualConsensusDWFA,
+        "priority": PriorityConsensusDWFA,
+    }[kind](cfg)
+    for r in reads_or_chains:
+        if kind == "priority":
+            engine.add_sequence_chain(r)
+        else:
+            engine.add_sequence(r)
+    return engine
+
+
+def _parity_gate():
+    """Five-scenario parity gate (BASELINE.md): jax-backend engines must
+    reproduce the golden fixtures exactly."""
+    from waffle_con_tpu import CdwfaConfigBuilder, DualConsensusDWFA
+    from waffle_con_tpu.models.priority_consensus import PriorityConsensusDWFA
+    from waffle_con_tpu.utils.fixtures import (
+        load_dual_fixture,
+        load_priority_fixture,
+    )
+
+    cfg = CdwfaConfigBuilder().wildcard(ord("*")).backend("jax").build()
+    checks = {}
+
+    def run_priority(name, include):
+        chains, expected = load_priority_fixture(name, include, cfg.consensus_cost)
+        engine = PriorityConsensusDWFA(cfg)
+        for chain in chains:
+            engine.add_sequence_chain(chain)
+        got = engine.consensus()
+        ok = got.sequence_indices == expected.sequence_indices and [
+            [c.sequence for c in chain] for chain in got.consensuses
+        ] == [[c.sequence for c in chain] for chain in expected.consensuses]
+        return bool(ok)
+
+    # single + errored + multi split + priority chains run through the
+    # priority stack (as the reference's own fixture tests do)
+    checks["single"] = run_priority("multi_exact_001", True)
+    checks["errored"] = run_priority("multi_err_001", False)
+    checks["multi_split"] = run_priority("multi_samesplit_001", True)
+    checks["priority_chains"] = run_priority("priority_001", True)
+
+    sequences, expected = load_dual_fixture("dual_001", True, cfg.consensus_cost)
+    engine = DualConsensusDWFA(cfg)
+    for s in sequences:
+        engine.add_sequence(s)
+    checks["dual_split"] = engine.consensus() == [expected]
+    return checks
+
+
+def bench_single(num_reads, seq_len, error_rate, parity=True, trace=None):
+    from waffle_con_tpu import CdwfaConfigBuilder
     from waffle_con_tpu.native import native_consensus
     from waffle_con_tpu.utils.example_gen import generate_test
 
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
-    num_reads = 16 if smoke else 256
-    seq_len = 1000 if smoke else 10_000
-    error_rate = 0.01
     min_count = max(2, num_reads // 4)
-
     gen_start = time.perf_counter()
     truth, reads = generate_test(4, seq_len, num_reads, error_rate, seed=0)
     gen_time = time.perf_counter() - gen_start
@@ -40,43 +105,212 @@ def run() -> None:
         CdwfaConfigBuilder().min_count(min_count).backend(backend).build()
     )
 
-    # CPU baseline: complete C++ engine
     cpu_start = time.perf_counter()
     cpu_results = native_consensus(reads, config=cfg("native"))
     cpu_time = time.perf_counter() - cpu_start
 
     # TPU engine: warm-up once (compile), then timed run
     def tpu_run():
-        engine = ConsensusDWFA(cfg("jax"))
-        for r in reads:
-            engine.add_sequence(r)
-        return engine.consensus()
+        engine = _make_engine("single", cfg("jax"), reads)
+        out = engine.consensus()
+        return engine, out
 
-    tpu_results = tpu_run()  # warm-up / compile
+    compile_start = time.perf_counter()
+    engine, tpu_results = tpu_run()
+    compile_time = time.perf_counter() - compile_start
+
+    if trace:
+        import jax
+
+        jax.profiler.start_trace(trace)
+    tpu_start = time.perf_counter()
+    engine, tpu_results = tpu_run()
+    tpu_time = time.perf_counter() - tpu_start
+    if trace:
+        import jax
+
+        jax.profiler.stop_trace()
+
+    stats = getattr(engine, "last_search_stats", {})
+    counters = stats.get("scorer_counters", {})
+    dispatches = sum(
+        counters.get(k, 0)
+        for k in (
+            "push_calls", "run_calls", "stats_calls", "clone_calls",
+            "activate_calls", "finalize_calls",
+        )
+    )
+    result = {
+        "metric": f"consensus_{num_reads}x{seq_len}_wall_s",
+        "value": round(tpu_time, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "cpu_baseline_s": round(cpu_time, 4),
+        "parity": bool(
+            [(c.sequence, c.scores) for c in tpu_results] == cpu_results
+        ),
+        "recovered_truth": bool(
+            tpu_results and tpu_results[0].sequence == truth
+        ),
+        "gen_s": round(gen_time, 2),
+        "breakdown": {
+            "warmup_incl_compile_s": round(compile_time, 2),
+            "consensus_len": len(tpu_results[0].sequence) if tpu_results else 0,
+            "device_dispatches": dispatches,
+            "run_extend_calls": counters.get("run_calls", 0),
+            "run_extend_steps": counters.get("run_steps", 0),
+            "push_calls": counters.get("push_calls", 0),
+            "grow_events": counters.get("grow_e_events", 0),
+            "replayed_cols": counters.get("replayed_cols", 0),
+            "nodes_explored": stats.get("nodes_explored", 0),
+            "steps_per_s": round(
+                (counters.get("run_steps", 0) + counters.get("push_calls", 0))
+                / max(tpu_time, 1e-9)
+            ),
+        },
+    }
+    if parity:
+        gate = _parity_gate()
+        result["parity_gate"] = gate
+        result["parity"] = bool(result["parity"] and all(gate.values()))
+    return result
+
+
+def bench_dual(num_reads, seq_len, error_rate):
+    """Dual north-star: two haplotypes differing by 3 SNPs, half the reads
+    each; CPU baseline is the complete C++ dual engine."""
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.native import native_dual_consensus
+    from waffle_con_tpu.utils.example_gen import generate_test
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    truth, reads1 = generate_test(4, seq_len, num_reads // 2, error_rate, seed=1)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=3, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    h2 = bytes(h2)
+    from waffle_con_tpu.utils.example_gen import corrupt
+
+    reads2 = [
+        corrupt(h2, error_rate, np.random.default_rng(100 + i))
+        for i in range(num_reads // 2)
+    ]
+    reads = list(reads1) + reads2
+
+    min_count = max(2, num_reads // 4)
+    cfg = lambda backend: (  # noqa: E731
+        CdwfaConfigBuilder().min_count(min_count).backend(backend).build()
+    )
+
+    cpu_start = time.perf_counter()
+    cpu_results = native_dual_consensus(reads, config=cfg("native"))
+    cpu_time = time.perf_counter() - cpu_start
+
+    def tpu_run():
+        return _make_engine("dual", cfg("jax"), reads).consensus()
+
+    tpu_results = tpu_run()
     tpu_start = time.perf_counter()
     tpu_results = tpu_run()
     tpu_time = time.perf_counter() - tpu_start
 
-    parity = [
-        (c.sequence, c.scores) for c in tpu_results
-    ] == cpu_results
-    recovered = tpu_results[0].sequence == truth if tpu_results else False
+    return {
+        "metric": f"dual_{num_reads}x{seq_len}_wall_s",
+        "value": round(tpu_time, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "cpu_baseline_s": round(cpu_time, 4),
+        "parity": bool(tpu_results == cpu_results),
+        "is_dual": bool(tpu_results and tpu_results[0].is_dual()),
+    }
 
+
+def bench_priority(num_reads, seq_len, error_rate):
+    """Priority north-star: 2-level chains splitting into two groups."""
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.native import native_priority_consensus
+    from waffle_con_tpu.utils.example_gen import generate_test, corrupt
+    import numpy as np
+
+    truth, level0 = generate_test(4, seq_len // 2, num_reads, error_rate, seed=3)
+    t1a, _ = generate_test(4, seq_len, 1, 0.0, seed=4)
+    t1b = bytearray(t1a)
+    t1b[seq_len // 3] = (t1b[seq_len // 3] + 1) % 4
+    t1b[2 * seq_len // 3] = (t1b[2 * seq_len // 3] + 2) % 4
+    t1b = bytes(t1b)
+    chains = []
+    for i in range(num_reads):
+        level1_truth = t1a if i < num_reads // 2 else t1b
+        lvl1 = corrupt(level1_truth, error_rate, np.random.default_rng(200 + i))
+        chains.append([level0[i], lvl1])
+
+    min_count = max(2, num_reads // 4)
+    cfg = lambda backend: (  # noqa: E731
+        CdwfaConfigBuilder().min_count(min_count).backend(backend).build()
+    )
+
+    cpu_start = time.perf_counter()
+    cpu_result = native_priority_consensus(chains, config=cfg("native"))
+    cpu_time = time.perf_counter() - cpu_start
+
+    def tpu_run():
+        return _make_engine("priority", cfg("jax"), chains).consensus()
+
+    tpu_result = tpu_run()
+    tpu_start = time.perf_counter()
+    tpu_result = tpu_run()
+    tpu_time = time.perf_counter() - tpu_start
+
+    return {
+        "metric": f"priority_{num_reads}x{seq_len}_wall_s",
+        "value": round(tpu_time, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "cpu_baseline_s": round(cpu_time, 4),
+        "parity": bool(tpu_result == cpu_result),
+        "groups": len(tpu_result.consensuses),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--grid", action="store_true")
+    parser.add_argument("--dual", action="store_true")
+    parser.add_argument("--priority", action="store_true")
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--trace", default=None)
+    args = parser.parse_args()
+
+    if args.grid:
+        # reference criterion grid (consensus_bench.rs:9-33)
+        for seq_len in (1000, 10_000):
+            for num_samples in (8, 30):
+                for error_rate in (0.0, 0.01, 0.02):
+                    out = bench_single(
+                        num_samples, seq_len, error_rate, parity=False
+                    )
+                    out["metric"] = (
+                        f"consensus_4x{seq_len}x{num_samples}_{error_rate}"
+                    )
+                    print(json.dumps(out))
+        return
+    if args.dual:
+        print(json.dumps(bench_dual(64, 5000, 0.01)))
+        return
+    if args.priority:
+        print(json.dumps(bench_priority(32, 2000, 0.01)))
+        return
+
+    smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
+    num_reads = 16 if smoke else 256
+    seq_len = 1000 if smoke else 10_000
     print(
         json.dumps(
-            {
-                "metric": f"consensus_{num_reads}x{seq_len}_wall_s",
-                "value": round(tpu_time, 4),
-                "unit": "s",
-                "vs_baseline": round(cpu_time / tpu_time, 3),
-                "cpu_baseline_s": round(cpu_time, 4),
-                "parity": bool(parity),
-                "recovered_truth": bool(recovered),
-                "gen_s": round(gen_time, 2),
-            }
+            bench_single(num_reads, seq_len, 0.01, trace=args.trace)
         )
     )
 
 
 if __name__ == "__main__":
-    run()
+    main()

@@ -438,6 +438,13 @@ class ConsensusDWFA:
         logger.debug("nodes_explored: %d", nodes_explored)
         logger.debug("nodes_ignored: %d", nodes_ignored)
         logger.debug("peak_queue_size: %d", peak_queue_size)
+        #: search-shape observability for bench.py / profiling
+        self.last_search_stats = {
+            "nodes_explored": nodes_explored,
+            "nodes_ignored": nodes_ignored,
+            "peak_queue_size": peak_queue_size,
+            "scorer_counters": dict(getattr(scorer, "counters", {})),
+        }
         return results
 
     # ------------------------------------------------------------------

@@ -544,6 +544,19 @@ class JaxScorer(WavefrontScorer):
         self._free: List[int] = list(range(self._B))
         self._next_handle = 0
         self._slot_of = {}
+        #: dispatch/step counters for bench + profiling observability
+        self.counters = {
+            "push_calls": 0,
+            "push_branches": 0,
+            "run_calls": 0,
+            "run_steps": 0,
+            "stats_calls": 0,
+            "clone_calls": 0,
+            "activate_calls": 0,
+            "finalize_calls": 0,
+            "grow_e_events": 0,
+            "replayed_cols": 0,
+        }
 
     # -- geometry ------------------------------------------------------
 
@@ -582,6 +595,8 @@ class JaxScorer(WavefrontScorer):
         geometry (band values outside the old window are unknown, so the
         recorded consensus is re-scanned on device)."""
         self._E *= 2
+        self.counters["grow_e_events"] += 1
+        self.counters["replayed_cols"] += int(self._state["clen"].max())
         st = self._state
         D, e, rmin, er = _j_replay(
             st["off"], st["act"], st["cons"], st["clen"],
@@ -633,6 +648,7 @@ class JaxScorer(WavefrontScorer):
         return handle
 
     def clone(self, h: int) -> int:
+        self.counters["clone_calls"] += 1
         src = self._slot_of[h]
         handle, dst = self._alloc()
         self._state = _j_clone(self._state, src, dst)
@@ -642,6 +658,7 @@ class JaxScorer(WavefrontScorer):
         """One fused scatter-copy for a batch of branch clones."""
         if not hs:
             return []
+        self.counters["clone_calls"] += 1
         srcs = [self._slot_of[h] for h in hs]
         alloc = [self._alloc() for _ in hs]
         handles = [a[0] for a in alloc]
@@ -671,6 +688,8 @@ class JaxScorer(WavefrontScorer):
         appended symbol (vmapped over branch slots)."""
         if not specs:
             return []
+        self.counters["push_calls"] += 1
+        self.counters["push_branches"] += len(specs)
         for _, consensus in specs:
             while len(consensus) >= self._C - 1:
                 self._grow_cons()
@@ -702,6 +721,7 @@ class JaxScorer(WavefrontScorer):
             ]
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
+        self.counters["stats_calls"] += 1
         slot = self._slot_of[h]
         return self._to_host(
             _j_stats(
@@ -712,6 +732,7 @@ class JaxScorer(WavefrontScorer):
     def activate(
         self, h: int, read_index: int, offset: int, consensus: bytes
     ) -> None:
+        self.counters["activate_calls"] += 1
         slot = self._slot_of[h]
         while True:
             state, overflow = _j_activate(
@@ -779,6 +800,8 @@ class JaxScorer(WavefrontScorer):
         )
         steps = int(steps)
         code = int(code)
+        self.counters["run_calls"] += 1
+        self.counters["run_steps"] += steps
         self._state = state
         appended = b""
         if steps:
@@ -791,6 +814,7 @@ class JaxScorer(WavefrontScorer):
         return steps, code, appended
 
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
+        self.counters["finalize_calls"] += 1
         slot = self._slot_of[h]
         while True:
             eds, overflow = _j_finalize(self._state, slot)
