@@ -11,6 +11,14 @@ parity gate (single, errored, dual split, multi split, priority chains,
 per BASELINE.md).  ``vs_baseline`` > 1 is a speedup over the CPU
 baseline.
 
+The default mode is failure-proof by construction: the device backend is
+probed in a subprocess under a hard timeout (TPU tunnels here can hang
+during init, not just error — see BENCH_r02.json), each bench attempt
+runs in its own subprocess with a timeout, and on failure the scale is
+reduced and finally the JAX-on-CPU backend is substituted.  The process
+always prints exactly one JSON line and exits 0; ``backend_diag``
+records what happened.
+
 Other modes (one JSON line per config):
   --grid      the reference criterion grid
               (``/root/reference/benches/consensus_bench.rs:9-33``):
@@ -21,15 +29,105 @@ Other modes (one JSON line per config):
   --smoke     16x1000 quick validation (also via BENCH_SMOKE=1).
 
 ``--trace DIR`` wraps the timed run in a ``jax.profiler`` trace.
+``--platform {auto,cpu,device}`` pins the JAX backend (default auto:
+probe, prefer the device, fall back to cpu).
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+FULL_TIMEOUT_S = int(os.environ.get("BENCH_FULL_TIMEOUT", "1500"))
+FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_FALLBACK_TIMEOUT", "600"))
+
+
+def _force_cpu_backend() -> None:
+    """Pin JAX to the host CPU backend.  The ambient env pins
+    ``JAX_PLATFORMS`` to the TPU plugin and a sitecustomize re-registers
+    it, so ``jax.config.update`` before first backend use is the reliable
+    switch (same approach as tests/conftest.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _run_captured(cmd, timeout_s):
+    """Run ``cmd`` capturing output, with a timeout that kills the whole
+    process *group* — a plain ``subprocess.run(timeout=...)`` SIGKILLs
+    only the direct child and then blocks draining the pipes, which hangs
+    forever if a TPU-runtime helper grandchild inherited them.
+
+    Returns ``(rc | None, stdout, stderr)``; ``rc is None`` on timeout."""
+    import signal
+
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover - last resort
+            out, err = "", ""
+        return None, out, err
+
+
+def _last_json_line(stdout: str):
+    """The last stdout line that parses as a JSON object (tolerates
+    trailing runtime/log chatter), or ``None``."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _probe_device(timeout_s: int = PROBE_TIMEOUT_S):
+    """Initialize the default JAX backend in a THROWAWAY subprocess with a
+    hard wall-clock limit; returns ``(info_dict | None, diagnostic)``.
+
+    A subprocess is the only safe probe: backend init here can hang
+    indefinitely inside C++ (remote-compile tunnel), which no in-process
+    try/except can bound."""
+    code = (
+        "import json, jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "x = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready();"
+        "print(json.dumps({'platform': d[0].platform, 'n_devices': len(d)}))"
+    )
+    try:
+        rc, out, err = _run_captured([sys.executable, "-c", code], timeout_s)
+    except Exception as exc:  # pragma: no cover - probe plumbing
+        return None, f"device probe error: {exc!r}"
+    if rc is None:
+        return None, f"device probe timed out after {timeout_s}s"
+    if rc == 0:
+        info = _last_json_line(out)
+        if info is not None and isinstance(info.get("platform"), str):
+            return info, "ok"
+    tail = (err or out or "").strip().splitlines()
+    return None, "device probe failed: " + " | ".join(tail[-4:])[-600:]
 
 
 def _make_engine(kind, cfg, reads_or_chains):
@@ -273,6 +371,96 @@ def bench_priority(num_reads, seq_len, error_rate):
     }
 
 
+def _run_attempt_subprocess(num_reads, seq_len, platform, trace, timeout_s):
+    """Run one bench attempt in a subprocess (hang- and crash-proof);
+    returns ``(result_dict | None, diagnostic)``."""
+    cmd = [
+        sys.executable,
+        os.path.abspath(__file__),
+        "--_run",
+        "--reads",
+        str(num_reads),
+        "--len",
+        str(seq_len),
+        "--platform",
+        platform,
+    ]
+    if trace:
+        cmd += ["--trace", trace]
+    try:
+        rc, out, err = _run_captured(cmd, timeout_s)
+    except Exception as exc:  # pragma: no cover - subprocess plumbing
+        return None, f"attempt launch error: {exc!r}"
+    if rc is None:
+        return None, (
+            f"attempt {num_reads}x{seq_len}@{platform} timed out after {timeout_s}s"
+        )
+    result = _last_json_line(out)
+    if result is not None and "metric" in result:
+        return result, "ok"
+    tail = (err or out or "").strip().splitlines()
+    return None, (
+        f"attempt {num_reads}x{seq_len}@{platform} rc={rc}: "
+        + " | ".join(tail[-4:])[-600:]
+    )
+
+
+def _north_star_orchestrated(args) -> dict:
+    """Default mode: probe the backend, then walk a ladder of attempts,
+    each in a subprocess under a timeout.  Never raises."""
+    diag = {}
+    if args.platform == "cpu":
+        device_ok = False
+        diag["probe"] = "skipped (--platform cpu)"
+    elif args.platform == "device":
+        device_ok = True
+        diag["probe"] = "skipped (--platform device)"
+    else:
+        info, probe_msg = _probe_device()
+        diag["probe"] = probe_msg
+        device_ok = info is not None and info.get("platform") != "cpu"
+        if info is not None:
+            diag["device"] = info
+
+    smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
+    full = (16, 1000) if smoke else (256, 10_000)
+
+    ladder = []
+    if device_ok:
+        ladder.append((full[0], full[1], "device", FULL_TIMEOUT_S))
+        if not smoke:
+            ladder.append((64, 2000, "device", FALLBACK_TIMEOUT_S))
+            ladder.append((16, 1000, "device", FALLBACK_TIMEOUT_S))
+    if args.platform != "device":
+        ladder.append((full[0], full[1], "cpu", FULL_TIMEOUT_S))
+        if not smoke:
+            ladder.append((16, 1000, "cpu", FALLBACK_TIMEOUT_S))
+
+    failures = []
+    for num_reads, seq_len, platform, timeout_s in ladder:
+        result, msg = _run_attempt_subprocess(
+            num_reads, seq_len, platform, args.trace, timeout_s
+        )
+        if result is not None:
+            if failures:
+                diag["fallback_chain"] = failures
+            result["backend_diag"] = diag
+            return result
+        failures.append(msg)
+        print(f"bench attempt failed: {msg}", file=sys.stderr)
+
+    diag["fallback_chain"] = failures
+    return {
+        "metric": f"consensus_{full[0]}x{full[1]}_wall_s",
+        "value": 0,
+        "unit": "s",
+        "vs_baseline": 0,
+        "parity": False,
+        "error": "all bench attempts failed",
+        "backend_diag": diag,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--grid", action="store_true")
@@ -280,7 +468,35 @@ def main() -> None:
     parser.add_argument("--priority", action="store_true")
     parser.add_argument("--smoke", action="store_true")
     parser.add_argument("--trace", default=None)
+    parser.add_argument(
+        "--platform", choices=("auto", "cpu", "device"), default="auto"
+    )
+    # hidden: one in-process bench attempt (used by the orchestrator)
+    parser.add_argument("--_run", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--reads", type=int, default=256, help=argparse.SUPPRESS)
+    parser.add_argument("--len", type=int, dest="seq_len", default=10_000,
+                        help=argparse.SUPPRESS)
     args = parser.parse_args()
+
+    # in-process modes pin the backend themselves; the orchestrated default
+    # never touches jax in the parent (children carry --platform)
+    if args.platform == "cpu" and (
+        args._run or args.grid or args.dual or args.priority
+    ):
+        _force_cpu_backend()
+
+    if args._run:
+        try:
+            from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+            enable_compilation_cache()
+            out = bench_single(args.reads, args.seq_len, 0.01, trace=args.trace)
+            out["device_platform"] = _current_platform()
+            print(json.dumps(out))
+        except Exception:
+            traceback.print_exc()
+            sys.exit(1)
+        return
 
     if args.grid:
         # reference criterion grid (consensus_bench.rs:9-33)
@@ -293,23 +509,30 @@ def main() -> None:
                     out["metric"] = (
                         f"consensus_4x{seq_len}x{num_samples}_{error_rate}"
                     )
-                    print(json.dumps(out))
+                    out["device_platform"] = _current_platform()
+                    print(json.dumps(out), flush=True)
         return
     if args.dual:
-        print(json.dumps(bench_dual(64, 5000, 0.01)))
+        out = bench_dual(64, 5000, 0.01)
+        out["device_platform"] = _current_platform()
+        print(json.dumps(out))
         return
     if args.priority:
-        print(json.dumps(bench_priority(32, 2000, 0.01)))
+        out = bench_priority(32, 2000, 0.01)
+        out["device_platform"] = _current_platform()
+        print(json.dumps(out))
         return
 
-    smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
-    num_reads = 16 if smoke else 256
-    seq_len = 1000 if smoke else 10_000
-    print(
-        json.dumps(
-            bench_single(num_reads, seq_len, 0.01, trace=args.trace)
-        )
-    )
+    print(json.dumps(_north_star_orchestrated(args)))
+
+
+def _current_platform() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:  # pragma: no cover - diagnostics only
+        return "unknown"
 
 
 if __name__ == "__main__":
