@@ -176,6 +176,61 @@ def test_dual_engine_backend_parity_small():
         assert a.consensus1.scores == b.consensus1.scores
 
 
+@pytest.mark.parametrize("weighted", [False, True])
+def test_dual_engine_run_extend_parity(weighted):
+    """Two noisy haplotypes at a size where the dual device run loop
+    (``run_extend_dual``) engages for the clean stretches: results,
+    scores, and read assignments must be byte-identical to the oracle."""
+    truth, reads1 = generate_test(4, 160, 5, 0.02, seed=29)
+    rng = np.random.default_rng(290)
+    h2 = bytearray(truth)
+    for pos in rng.choice(160, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    h2 = bytes(h2)
+    from waffle_con_tpu.utils.example_gen import corrupt
+
+    reads2 = [
+        corrupt(h2, 0.02, np.random.default_rng(300 + i)) for i in range(5)
+    ]
+    reads = list(reads1) + reads2
+
+    results = {}
+    import waffle_con_tpu.models.dual_consensus as dc
+
+    captured = {}
+    orig = dc.make_scorer
+
+    def spy(seqs, config):
+        scorer = orig(seqs, config)
+        captured[config.backend] = scorer
+        return scorer
+
+    dc.make_scorer = spy
+    try:
+        for backend in ("python", "jax"):
+            engine = DualConsensusDWFA(
+                CdwfaConfigBuilder()
+                .min_count(2)
+                .weighted_by_ed(weighted)
+                .backend(backend)
+                .build()
+            )
+            for r in reads:
+                engine.add_sequence(r)
+            results[backend] = engine.consensus()
+    finally:
+        dc.make_scorer = orig
+
+    assert results["python"] == results["jax"]
+    for a, b in zip(results["python"], results["jax"]):
+        assert a.scores1 == b.scores1
+        assert a.scores2 == b.scores2
+        assert a.is_consensus1 == b.is_consensus1
+    # the device fast path must actually have carried part of the search
+    counters = captured["jax"].counters
+    assert counters["run_steps"] + counters["run_dual_steps"] > 0
+
+
 def test_dual_engine_backend_parity_fixture():
     from waffle_con_tpu import ConsensusCost
 

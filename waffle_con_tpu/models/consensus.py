@@ -97,6 +97,46 @@ def shift_offsets(
     return [None if o == min_offset else o - min_offset for o in offsets]
 
 
+def replay_run_bookkeeping(
+    tracker: PQueueTracker,
+    cfg: CdwfaConfig,
+    top_len: int,
+    steps: int,
+    farthest: int,
+    last_constraint: int,
+    on_length=None,
+) -> Tuple[int, int]:
+    """Replay the per-length tracker bookkeeping for a device-committed
+    extension run, exactly as the per-symbol host loop would have done it:
+    threshold constriction, remove/process/insert, and the farthest /
+    constraint counters.  ``on_length`` runs once per replayed length for
+    engine-specific tables.  Returns updated ``(farthest,
+    last_constraint)``.
+
+    Capacity stops cannot fire mid-run: the run only engages when the node
+    is at the frontier (``top_len >= farthest``), so every replayed length
+    beyond the first has never been processed, and the first is the pop's
+    own process.
+    """
+    for j in range(steps):
+        length = top_len + j
+        if j > 0:
+            while (
+                len(tracker) > cfg.max_queue_size
+                or last_constraint >= cfg.max_nodes_wo_constraint
+            ) and tracker.threshold() < farthest:
+                tracker.increment_threshold()
+                last_constraint = 0
+            tracker.remove(length)
+        farthest = max(farthest, length)
+        last_constraint += 1
+        tracker.process(length)
+        tracker.insert(length + 1)
+        if on_length is not None:
+            on_length(length)
+    return farthest, last_constraint
+
+
 def candidates_from_stats(
     stats: BranchStats,
     symtab: np.ndarray,
@@ -295,26 +335,16 @@ class ConsensusDWFA:
                 next_act = min(
                     (l for l in activate_points if l > top_len), default=None
                 )
-                cap_stop = next(
-                    (
-                        l
-                        for l in range(top_len + 1, farthest_consensus + 1)
-                        if tracker.at_capacity(l)
-                    ),
-                    None,
-                )
                 max_steps = self._max_sequence_len * 2 + 256
                 if next_act is not None:
                     max_steps = min(max_steps, next_act - top_len - 1)
-                if cap_stop is not None:
-                    max_steps = min(max_steps, cap_stop - top_len)
                 if max_steps >= 1:
                     budget = (
                         int(run_budget)
                         if run_budget != math.inf
                         else 2**31 - 1
                     )
-                    steps, _code, appended = run_extend(
+                    steps, _code, appended, run_stats = run_extend(
                         node.handle,
                         node.consensus,
                         budget,
@@ -323,24 +353,19 @@ class ConsensusDWFA:
                         max_steps,
                     )
                     if steps > 0:
-                        for j in range(steps):
-                            length = top_len + j
-                            if j > 0:
-                                while (
-                                    len(tracker) > cfg.max_queue_size
-                                    or last_constraint
-                                    >= cfg.max_nodes_wo_constraint
-                                ) and tracker.threshold() < farthest_consensus:
-                                    tracker.increment_threshold()
-                                    last_constraint = 0
-                                tracker.remove(length)
-                            farthest_consensus = max(farthest_consensus, length)
-                            nodes_explored += 1
-                            last_constraint += 1
-                            tracker.process(length)
-                            tracker.insert(length + 1)
+                        farthest_consensus, last_constraint = (
+                            replay_run_bookkeeping(
+                                tracker,
+                                cfg,
+                                top_len,
+                                steps,
+                                farthest_consensus,
+                                last_constraint,
+                            )
+                        )
+                        nodes_explored += steps
                         node.consensus = node.consensus + appended
-                        node.stats = scorer.stats(node.handle, node.consensus)
+                        node.stats = run_stats
                         if not pqueue.push(
                             node.key(), node, node.priority(cost)
                         ):  # pragma: no cover - chain nodes are unique

@@ -22,6 +22,7 @@ from waffle_con_tpu.models.consensus import (
     Consensus,
     EngineError,
     candidates_from_stats,
+    replay_run_bookkeeping,
     shift_offsets,
     check_invariant,
 )
@@ -372,6 +373,139 @@ class DualConsensusDWFA:
                 nodes_ignored += 1
                 self._free_node(scorer, node)
                 continue
+
+            # -- device fast path: when this node is the whole frontier of
+            # its kind, let the scorer extend it through unambiguous
+            # stretches on device (see models/consensus.py for the budget
+            # argument; dual nodes step BOTH branches per iteration with
+            # on-device divergence pruning).  min_af == 0 keeps every vote
+            # threshold static; a locked side would stall the max-length
+            # bookkeeping, so those fall back to per-symbol flow.
+            farthest_kind = farthest_dual if node.is_dual else farthest_single
+            kind_tracker = dual_tracker if node.is_dual else single_tracker
+            runnable = (
+                cfg.min_af == 0.0
+                and top_len >= farthest_kind
+                and (
+                    (
+                        node.is_dual
+                        and not node.lock1
+                        and not node.lock2
+                        and getattr(scorer, "run_extend_dual", None) is not None
+                    )
+                    or (
+                        not node.is_dual
+                        and getattr(scorer, "run_extend", None) is not None
+                    )
+                )
+            )
+            if runnable:
+                best_other = pqueue.peek_priority()
+                run_budget = maximum_error
+                if best_other is not None:
+                    run_budget = min(run_budget, -best_other[0] - 1)
+                if run_budget >= top_cost:
+                    next_act = min(
+                        (l for l in activate_points if l > top_len), default=None
+                    )
+                    max_steps = initial_size * 2 + 256
+                    if next_act is not None:
+                        max_steps = min(max_steps, next_act - top_len - 1)
+                    if max_steps >= 1:
+                        budget = (
+                            int(run_budget)
+                            if run_budget != math.inf
+                            else 2**31 - 1
+                        )
+                        l2 = cost is ConsensusCost.L2_DISTANCE
+                        if node.is_dual:
+                            (
+                                steps,
+                                _code,
+                                app1,
+                                app2,
+                                stats1,
+                                stats2,
+                                act1,
+                                act2,
+                            ) = scorer.run_extend_dual(
+                                node.h1,
+                                node.h2,
+                                node.consensus1,
+                                node.consensus2,
+                                budget,
+                                cfg.min_count,
+                                cfg.dual_max_ed_delta,
+                                active_min_count[top_len],
+                                l2,
+                                cfg.weighted_by_ed,
+                                max_steps,
+                            )
+                        else:
+                            steps, _code, app1, stats1 = scorer.run_extend(
+                                node.h1,
+                                node.consensus1,
+                                budget,
+                                cfg.min_count,
+                                l2,
+                                max_steps,
+                            )
+                        if steps > 0:
+
+                            def extend_tables(length):
+                                if len(active_min_count) == length + 1:
+                                    new_total = total_active_count[length] + len(
+                                        activate_points.get(length, [])
+                                    )
+                                    total_active_count.append(new_total)
+                                    active_min_count.append(
+                                        max(
+                                            cfg.min_count,
+                                            math.ceil(cfg.min_af * new_total),
+                                        )
+                                    )
+
+                            kind_constraint = (
+                                dual_last_constraint
+                                if node.is_dual
+                                else single_last_constraint
+                            )
+                            farthest_kind, kind_constraint = (
+                                replay_run_bookkeeping(
+                                    kind_tracker,
+                                    cfg,
+                                    top_len,
+                                    steps,
+                                    farthest_kind,
+                                    kind_constraint,
+                                    on_length=extend_tables,
+                                )
+                            )
+                            nodes_explored += steps
+                            if node.is_dual:
+                                farthest_dual = farthest_kind
+                                dual_last_constraint = kind_constraint
+                            else:
+                                farthest_single = farthest_kind
+                                single_last_constraint = kind_constraint
+                            node.consensus1 = node.consensus1 + app1
+                            node.stats1 = stats1
+                            if node.is_dual:
+                                node.consensus2 = node.consensus2 + app2
+                                node.stats2 = stats2
+                                for r in range(n_seqs):
+                                    if node.active1[r] and not bool(act1[r]):
+                                        node.active1[r] = False
+                                        node.offsets1[r] = None
+                                    if node.active2[r] and not bool(act2[r]):
+                                        node.active2[r] = False
+                                        node.offsets2[r] = None
+                            if not pqueue.push(
+                                node.key(), node, node.priority(cost)
+                            ):  # pragma: no cover - chain nodes are unique
+                                kind_tracker.remove(node.max_consensus_length())
+                                self._free_node(scorer, node)
+                            continue
 
             if node.is_dual:
                 farthest_dual = max(farthest_dual, top_len)

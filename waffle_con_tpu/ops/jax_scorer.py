@@ -64,6 +64,12 @@ from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
 
 INF = jnp.int32(1 << 20)
 
+#: f32-vs-f64 vote-sum comparison margin for the device run loops: decisions
+#: with margins under this are host events.  Conservatively above the worst
+#: accumulated f32 error for thousands of reads (exact one-hot integer votes
+#: bypass it entirely, so clean stretches never false-stop).
+VOTE_EPS = jnp.float32(1e-2)
+
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
     return max(minimum, 1 << max(0, (n - 1).bit_length()))
@@ -369,7 +375,7 @@ def _j_run(
         # f64 read order; device f32 reductions agree on every >=-decision
         # whenever the comparison margin exceeds EPS, so we continue only
         # on clear margins (exact when all reads are single-tip).
-        EPS = jnp.float32(1e-3)
+        EPS = VOTE_EPS
         voters = occ > 0  # [R, A]
         has_votes = voters.any(axis=0)
         n_cands = has_votes.sum()
@@ -402,8 +408,11 @@ def _j_run(
         ambiguous = ~all_onehot & near_tie
         dirty = ambiguous | (npass != 1) | (n_cands == 0) | cost_overflow
 
+        # early-termination runs freeze a reached read rather than ending
+        # the search, so only stop when the node as a whole may be complete
+        reached_stop = jnp.where(et, (reached | ~act).all(), reached.any())
         code = jnp.where(
-            reached.any(),
+            reached_stop,
             2,
             jnp.where(
                 total > budget,
@@ -447,6 +456,9 @@ def _j_run(
     D, e, rmin, er, cons, clen, steps, code = lax.while_loop(
         lambda c: c[7] == 0, body, init
     )
+    stats = _stats_core(
+        D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
+    )
     out = dict(state)
     out["D"] = state["D"].at[h].set(D)
     out["e"] = state["e"].at[h].set(e)
@@ -454,7 +466,238 @@ def _j_run(
     out["er"] = state["er"].at[h].set(er)
     out["cons"] = state["cons"].at[h].set(cons)
     out["clen"] = state["clen"].at[h].set(clen)
-    return out, steps, code
+    return out, steps, code, stats
+
+
+def _dual_votes(occ, split, w, wc, weighted):
+    """Per-side fractional vote fold for the dual run loop, mirroring the
+    host's ``candidates_from_stats`` with per-read weights: each voting
+    read (weight > 0, any tips) splits ``w`` across its tip symbols; the
+    wildcard column is dropped whenever another candidate exists.
+
+    Returns ``(counts[A] f32, has_votes[A], n_cands, exactable)`` where
+    ``exactable`` means every voting read is single-tip (so with the
+    non-weighted {0, 0.5, 1} weight lattice the f32 sums are exact)."""
+    voting = (w > 0) & (split > 0)
+    voters = (occ > 0) & voting[:, None]
+    frac = jnp.where(
+        split[:, None] > 0,
+        occ.astype(jnp.float32)
+        / jnp.maximum(split, 1)[:, None].astype(jnp.float32),
+        0.0,
+    ) * w[:, None]
+    counts = jnp.where(voters, frac, 0.0).sum(axis=0)
+    has_votes = voters.any(axis=0)
+    n_cands = has_votes.sum()
+    wc_col = jnp.maximum(wc, 0)
+    drop_wc = (wc >= 0) & (n_cands > 1)
+    has_votes = jnp.where(drop_wc, has_votes.at[wc_col].set(False), has_votes)
+    counts = jnp.where(drop_wc, counts.at[wc_col].set(0.0), counts)
+    n_cands = has_votes.sum()
+    exactable = (
+        jnp.where(voting, (occ > 0).sum(axis=1), 0) <= 1
+    ).all() & ~weighted
+    return counts, has_votes, n_cands, exactable
+
+
+@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
+def _j_run_dual(
+    state, reads, rlen, ha, hb, budget, min_count, delta, imb_min,
+    l2, weighted, wc, et, max_steps, num_symbols,
+):
+    """Device-resident extension of a *dual* node: both branches advance
+    one symbol per iteration while each side's nomination is unambiguous,
+    with divergence pruning (``dual_max_ed_delta``) applied on device
+    exactly as the host would (integer compares on post-push distances).
+
+    Preconditions (enforced by the engine): neither side locked, and
+    ``min_af == 0`` so the vote thresholds are static.
+
+    Stop codes: 1 = host arbitration (ambiguous votes, != 1 passing
+    symbol on a side, a side ran out of candidates, or a side finished),
+    2 = some read reached its baseline end, 3 = cost exceeded budget,
+    4 = step limit, 5 = band overflow (last step not committed),
+    6 = committed step made the node imbalanced (host pop discards it).
+
+    This is the dual twin of ``_j_run`` and the answer to the reference's
+    quadratic dual extension loop
+    (``/root/reference/src/dual_consensus.rs:606-734``): clean dual
+    stretches cost one host round-trip per *event*, not ~5 dispatches per
+    appended base.
+    """
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    C = state["cons"].shape[1]
+    offa = state["off"][ha]
+    offb = state["off"][hb]
+    EPS = VOTE_EPS
+    min_count_f = min_count.astype(jnp.float32)
+
+    def body(carry):
+        (Da, ea, rmina, era, acta, consa, clena,
+         Db, eb, rminb, erb, actb, consb, clenb, steps, _code) = carry
+
+        edsa, occa, splita, reacheda = _stats_core(
+            Da, ea, rmina, era, offa, acta, rlen, reads, clena, num_symbols, E
+        )
+        edsb, occb, splitb, reachedb = _stats_core(
+            Db, eb, rminb, erb, offb, actb, rlen, reads, clenb, num_symbols, E
+        )
+
+        # total node cost = per read, best over its tracked sides
+        BIG = jnp.int32(1 << 28)
+        ca = jnp.where(l2, edsa * edsa, edsa)
+        cb = jnp.where(l2, edsb * edsb, edsb)
+        best = jnp.minimum(
+            jnp.where(acta, ca, BIG), jnp.where(actb, cb, BIG)
+        )
+        total = jnp.where(acta | actb, best, 0).sum()
+        cost_overflow = l2 & (
+            jnp.maximum(
+                jnp.where(acta, edsa, 0).max(), jnp.where(actb, edsb, 0).max()
+            )
+            > 2048
+        )
+
+        # per-read vote weights from relative edit distances (reference
+        # get_ed_weights, dual_consensus.rs:1299-1336)
+        both = acta & actb
+        c1f = jnp.maximum(edsa.astype(jnp.float32), 0.5)
+        c2f = jnp.maximum(edsb.astype(jnp.float32), 0.5)
+        denom = c1f + c2f
+        wa_soft = jnp.where(both, c2f / denom, jnp.where(acta, 1.0, 0.0))
+        wb_soft = jnp.where(both, c1f / denom, jnp.where(actb, 1.0, 0.0))
+        eq = both & (c1f == c2f)
+        wa_hard = jnp.where(
+            both,
+            jnp.where(eq, 0.5, jnp.where(c1f < c2f, 1.0, 0.0)),
+            jnp.where(acta, 1.0, 0.0),
+        )
+        wb_hard = jnp.where(
+            both,
+            jnp.where(eq, 0.5, jnp.where(c2f < c1f, 1.0, 0.0)),
+            jnp.where(actb, 1.0, 0.0),
+        )
+        wa = jnp.where(weighted, wa_soft, wa_hard)
+        wb = jnp.where(weighted, wb_soft, wb_hard)
+
+        def side(occ, split, w):
+            counts, has_votes, n_cands, exactable = _dual_votes(
+                occ, split, w, wc, weighted
+            )
+            maxc = jnp.where(has_votes, counts, -1.0).max()
+            thr = jnp.minimum(min_count_f, maxc)
+            passing = has_votes & (counts >= thr)
+            npass = passing.sum()
+            near_tie = (
+                (jnp.abs(maxc - min_count_f) < EPS)
+                | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
+            )
+            ambiguous = ~exactable & near_tie
+            dirty = ambiguous | (npass != 1) | (n_cands == 0)
+            sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(
+                jnp.int32
+            )
+            return dirty, sym
+
+        dirty_a, sym_a = side(occa, splita, wa)
+        dirty_b, sym_b = side(occb, splitb, wb)
+
+        # a side counting as finished adds a do-not-extend option to the
+        # host's cross product — host arbitration either way
+        reached_read = (acta & reacheda) | (actb & reachedb)
+        fin_a = jnp.where(
+            et, (reacheda | ~acta).all(), (acta & reacheda).any()
+        )
+        fin_b = jnp.where(
+            et, (reachedb | ~actb).all(), (actb & reachedb).any()
+        )
+        reached_stop = jnp.where(et, reached_read.all(), reached_read.any())
+
+        code = jnp.where(
+            reached_stop,
+            2,
+            jnp.where(
+                total > budget,
+                3,
+                jnp.where(
+                    dirty_a | dirty_b | fin_a | fin_b | cost_overflow,
+                    1,
+                    jnp.where(steps >= max_steps, 4, 0),
+                ),
+            ),
+        )
+
+        consa2 = consa.at[jnp.clip(clena, 0, C - 1)].set(sym_a)
+        consb2 = consb.at[jnp.clip(clenb, 0, C - 1)].set(sym_b)
+        Da2, ea2, rmina2, era2 = _col_step(
+            Da, ea, rmina, era, offa, acta, rlen, reads, clena + 1, sym_a,
+            wc, et, E,
+        )
+        Db2, eb2, rminb2, erb2 = _col_step(
+            Db, eb, rminb, erb, offb, actb, rlen, reads, clenb + 1, sym_b,
+            wc, et, E,
+        )
+        ovf = ((acta & (ea2 >= E)) | (actb & (eb2 >= E))).any()
+
+        # divergence pruning on post-push distances (host order:
+        # push both sides, then prune per read)
+        both2 = acta & actb
+        acta2 = acta & ~(both2 & (eb2 + delta < ea2))
+        actb2 = actb & ~(both2 & (ea2 + delta < eb2))
+        imb = (acta2.sum() < imb_min) | (actb2.sum() < imb_min)
+
+        commit = (code == 0) & ~ovf
+        code = jnp.where(
+            code != 0,
+            code,
+            jnp.where(ovf, 5, jnp.where(imb, 6, 0)),
+        )
+        sel = lambda c, new, old: jnp.where(c, new, old)  # noqa: E731
+        Da = sel(commit, Da2, Da)
+        ea = sel(commit, ea2, ea)
+        rmina = sel(commit, rmina2, rmina)
+        era = sel(commit, era2, era)
+        acta = sel(commit, acta2, acta)
+        consa = sel(commit, consa2, consa)
+        clena = sel(commit, clena + 1, clena)
+        Db = sel(commit, Db2, Db)
+        eb = sel(commit, eb2, eb)
+        rminb = sel(commit, rminb2, rminb)
+        erb = sel(commit, erb2, erb)
+        actb = sel(commit, actb2, actb)
+        consb = sel(commit, consb2, consb)
+        clenb = sel(commit, clenb + 1, clenb)
+        steps = steps + commit.astype(steps.dtype)
+        return (Da, ea, rmina, era, acta, consa, clena,
+                Db, eb, rminb, erb, actb, consb, clenb, steps, code)
+
+    init = (
+        state["D"][ha], state["e"][ha], state["rmin"][ha], state["er"][ha],
+        state["act"][ha], state["cons"][ha], state["clen"][ha],
+        state["D"][hb], state["e"][hb], state["rmin"][hb], state["er"][hb],
+        state["act"][hb], state["cons"][hb], state["clen"][hb],
+        jnp.int32(0), jnp.int32(0),
+    )
+    (Da, ea, rmina, era, acta, consa, clena,
+     Db, eb, rminb, erb, actb, consb, clenb, steps, code) = lax.while_loop(
+        lambda c: c[15] == 0, body, init
+    )
+    stats_a = _stats_core(
+        Da, ea, rmina, era, offa, acta, rlen, reads, clena, num_symbols, E
+    )
+    stats_b = _stats_core(
+        Db, eb, rminb, erb, offb, actb, rlen, reads, clenb, num_symbols, E
+    )
+    out = dict(state)
+    out["D"] = state["D"].at[ha].set(Da).at[hb].set(Db)
+    out["e"] = state["e"].at[ha].set(ea).at[hb].set(eb)
+    out["rmin"] = state["rmin"].at[ha].set(rmina).at[hb].set(rminb)
+    out["er"] = state["er"].at[ha].set(era).at[hb].set(erb)
+    out["act"] = state["act"].at[ha].set(acta).at[hb].set(actb)
+    out["cons"] = state["cons"].at[ha].set(consa).at[hb].set(consb)
+    out["clen"] = state["clen"].at[ha].set(clena).at[hb].set(clenb)
+    return out, steps, code, stats_a, stats_b, acta, actb
 
 
 @partial(jax.jit, static_argnames=("W",))
@@ -550,6 +793,8 @@ class JaxScorer(WavefrontScorer):
             "push_branches": 0,
             "run_calls": 0,
             "run_steps": 0,
+            "run_dual_calls": 0,
+            "run_dual_steps": 0,
             "stats_calls": 0,
             "clone_calls": 0,
             "activate_calls": 0,
@@ -777,15 +1022,17 @@ class JaxScorer(WavefrontScorer):
         min_count: int,
         l2: bool,
         max_steps: int,
-    ) -> Tuple[int, int, bytes]:
+    ) -> Tuple[int, int, bytes, BranchStats]:
         """Device-side unambiguous-run extension; returns
-        ``(steps_committed, stop_code, appended_bytes)``.  See ``_j_run``
-        for the stop-code contract; on overflow the band is grown so the
-        caller can simply continue stepping."""
+        ``(steps_committed, stop_code, appended_bytes, stats)`` with
+        ``stats`` the branch snapshot at the stopped position (saving the
+        follow-up ``stats`` dispatch).  See ``_j_run`` for the stop-code
+        contract; on overflow the band is grown so the caller can simply
+        continue stepping."""
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
-        state, steps, code = _j_run(
+        state, steps, code, stats = _j_run(
             self._state,
             self._reads,
             self._rlen,
@@ -811,7 +1058,78 @@ class JaxScorer(WavefrontScorer):
             appended = bytes(int(self.symtab[i]) for i in ids)
         if code == 5:
             self._grow_e()
-        return steps, code, appended
+        return steps, code, appended, self._to_host(stats)
+
+    def run_extend_dual(
+        self,
+        h1: int,
+        h2: int,
+        consensus1: bytes,
+        consensus2: bytes,
+        budget: int,
+        min_count: int,
+        ed_delta: int,
+        imb_min: int,
+        l2: bool,
+        weighted: bool,
+        max_steps: int,
+    ):
+        """Device-side dual-node extension (both branches step together,
+        with on-device divergence pruning); returns ``(steps, stop_code,
+        appended1, appended2, stats1, stats2, active1, active2)``.  See
+        ``_j_run_dual`` for the stop-code contract.  Caller preconditions:
+        neither side locked, ``min_af == 0``."""
+        s1 = self._slot_of[h1]
+        s2 = self._slot_of[h2]
+        need = max(len(consensus1), len(consensus2)) + max_steps + 2
+        while need >= self._C:
+            self._grow_cons()
+        state, steps, code, stats1, stats2, act1, act2 = _j_run_dual(
+            self._state,
+            self._reads,
+            self._rlen,
+            s1,
+            s2,
+            jnp.int32(min(budget, 2**31 - 1)),
+            jnp.int32(min_count),
+            jnp.int32(ed_delta),
+            jnp.int32(imb_min),
+            jnp.bool_(l2),
+            jnp.bool_(weighted),
+            self._wc,
+            self._et,
+            jnp.int32(max_steps),
+            self.num_symbols,
+        )
+        steps = int(steps)
+        code = int(code)
+        self.counters["run_dual_calls"] += 1
+        self.counters["run_dual_steps"] += steps
+        self._state = state
+
+        def appended(slot, consensus):
+            if not steps:
+                return b""
+            ids = np.asarray(
+                state["cons"][slot, len(consensus) : len(consensus) + steps]
+            )
+            return bytes(int(self.symtab[i]) for i in ids)
+
+        app1 = appended(s1, consensus1)
+        app2 = appended(s2, consensus2)
+        if code == 5:
+            self._grow_e()
+        n = self.num_reads
+        return (
+            steps,
+            code,
+            app1,
+            app2,
+            self._to_host(stats1),
+            self._to_host(stats2),
+            np.asarray(act1[:n]),
+            np.asarray(act2[:n]),
+        )
 
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
         self.counters["finalize_calls"] += 1
