@@ -93,6 +93,13 @@ class CdwfaConfig:
     #: Engines are sharding-agnostic: results are identical on 1 or N
     #: chips.  Framework extension beyond the reference config.
     mesh_shards: int = 0
+    #: Speculatively expand up to this many queue nodes per scorer
+    #: dispatch (frontier-synchronous batching): the children of the
+    #: popped node and of the next best queued nodes are cloned and
+    #: pushed in one fused device call, and consumed (bit-identically)
+    #: when those nodes are actually popped.  1 disables speculation.
+    #: Framework extension beyond the reference config.
+    prefetch_width: int = 16
 
     def __post_init__(self) -> None:
         if self.wildcard is not None and not 0 <= self.wildcard <= 255:
@@ -101,6 +108,8 @@ class CdwfaConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.mesh_shards and self.backend != "jax":
             raise ValueError("mesh_shards requires the jax backend")
+        if self.prefetch_width < 1:
+            raise ValueError("prefetch_width must be >= 1")
 
 
 class CdwfaConfigBuilder:

@@ -37,6 +37,10 @@ from waffle_con_tpu.utils.pqueue import PQueueTracker, SetPriorityQueue
 
 logger = logging.getLogger(__name__)
 
+#: Per-engagement column cap for the device run loops: bounds the host-side
+#: bookkeeping simulation; long clean stretches simply re-engage next pop.
+RUN_SIM_CAP = 4096
+
 
 class EngineError(Exception):
     """Engine-level failure (coverage gaps, invalid inputs, ...).
@@ -175,9 +179,15 @@ def candidates_from_stats(
 
 
 class _Node:
-    """A search node: a partial consensus plus its scorer branch."""
+    """A search node: a partial consensus plus its scorer branch.
 
-    __slots__ = ("consensus", "handle", "active", "offsets", "stats")
+    ``prefetch`` holds this node's speculatively-expanded children —
+    ``(passing_symbols, {sym: [child_handle, child_stats]})`` — produced
+    by a fused multi-node dispatch before the node was popped.  It is a
+    pure cache: nomination is a deterministic function of ``stats``, so
+    consuming it at pop time is bit-identical to expanding then."""
+
+    __slots__ = ("consensus", "handle", "active", "offsets", "stats", "prefetch")
 
     def __init__(self, consensus, handle, active, offsets, stats):
         self.consensus: bytes = consensus
@@ -185,6 +195,7 @@ class _Node:
         self.active: List[bool] = active
         self.offsets: List[Optional[int]] = offsets
         self.stats: BranchStats = stats
+        self.prefetch = None
 
     def key(self) -> Tuple:
         # Active wavefront state is a deterministic function of
@@ -310,49 +321,76 @@ class ConsensusDWFA:
                 or tracker.at_capacity(top_len)
             ):
                 nodes_ignored += 1
+                self._drop_prefetch(scorer, node)
                 scorer.free(node.handle)
                 continue
 
-            # -- device fast path: when this node is the whole frontier, let
-            # the scorer extend it through unambiguous stretches on device
-            # (one host round-trip per event instead of per base), then
-            # replay the per-length bookkeeping exactly.
+            # -- device fast path: extend the popped node through
+            # unambiguous stretches on device (one host round-trip per
+            # event instead of per base), then replay the per-length
+            # bookkeeping exactly.  The run continues while the node keeps
+            # winning pops ((-cost, len) priority vs the best other queued
+            # entry; full ties lose to the earlier insert) and only
+            # engages when this pop's own nomination is a single candidate
+            # — otherwise step 0 would stop immediately.  max_steps is
+            # bounded by an exact host simulation of the threshold /
+            # capacity bookkeeping, so the run may start behind the
+            # farthest frontier without replaying a step the real search
+            # would have pruned.
             run_extend = getattr(scorer, "run_extend", None)
-            run_budget = -1
-            if run_extend is not None and top_len >= farthest_consensus:
-                # the run may continue while this node stays the strict
-                # pop-winner: its cost below every other queued node's
-                # (conservative on cost ties) and below the best result.
-                # Requiring top_len >= farthest keeps the replayed steps
-                # ahead of any threshold constriction (threshold always
-                # stays < farthest == the chain length), so the
-                # below-threshold prune can never fire on them.
+            if run_extend is not None:
+                passing_now = (
+                    node.prefetch[0]
+                    if node.prefetch is not None
+                    else self._nominate(scorer, node)
+                )
                 best_other = pqueue.peek_priority()
-                run_budget = maximum_error
+                other_cost = 2**31 - 1
+                other_len = 0
                 if best_other is not None:
-                    run_budget = min(run_budget, -best_other[0] - 1)
-            if run_extend is not None and run_budget >= top_cost:
+                    other_cost = -best_other[0]
+                    other_len = best_other[1]
+                engage = len(passing_now) == 1 and (
+                    top_cost < other_cost
+                    or (top_cost == other_cost and top_len > other_len)
+                )
+            else:
+                engage = False
+            if engage:
                 next_act = min(
                     (l for l in activate_points if l > top_len), default=None
                 )
-                max_steps = self._max_sequence_len * 2 + 256
+                max_steps = min(self._max_sequence_len * 2 + 256, RUN_SIM_CAP)
                 if next_act is not None:
                     max_steps = min(max_steps, next_act - top_len - 1)
                 if max_steps >= 1:
-                    budget = (
-                        int(run_budget)
-                        if run_budget != math.inf
+                    max_steps = tracker.simulate_run_bound(
+                        top_len,
+                        farthest_consensus,
+                        last_constraint,
+                        cfg.max_queue_size,
+                        cfg.max_nodes_wo_constraint,
+                        max_steps,
+                    )
+                if max_steps >= 1:
+                    me_budget = (
+                        int(maximum_error)
+                        if maximum_error != math.inf
                         else 2**31 - 1
                     )
                     steps, _code, appended, run_stats = run_extend(
                         node.handle,
                         node.consensus,
-                        budget,
+                        me_budget,
+                        other_cost,
+                        other_len,
                         cfg.min_count,
                         cost is ConsensusCost.L2_DISTANCE,
                         max_steps,
                     )
                     if steps > 0:
+                        # the branch advanced past the prefetched children
+                        self._drop_prefetch(scorer, node)
                         farthest_consensus, last_constraint = (
                             replay_run_bookkeeping(
                                 tracker,
@@ -395,15 +433,19 @@ class ConsensusDWFA:
                 if fin_total <= maximum_error and len(results) < cfg.max_return_size:
                     results.append(Consensus(node.consensus, cost, fin_scores))
 
-            # -- nominate extensions
-            candidates = candidates_from_stats(
-                node.stats, scorer.symtab, cfg.wildcard
-            )
-            max_observed = max(candidates.values(), default=float(cfg.min_count))
-            active_threshold = min(float(cfg.min_count), max_observed)
-            passing = sorted(
-                sym for sym, count in candidates.items() if count >= active_threshold
-            )
+            # -- nominate + expand (with frontier-synchronous batching:
+            # the popped node's children and the next best queued nodes'
+            # children go through ONE fused clone+push dispatch, consumed
+            # bit-identically when those nodes are popped)
+            if node.prefetch is None:
+                peers = [
+                    n
+                    for n, _p in pqueue.peek_top(cfg.prefetch_width - 1)
+                    if n.prefetch is None
+                ]
+                self._prefetch_expansions(scorer, [node] + peers)
+            passing, expansion = node.prefetch
+            node.prefetch = None
 
             new_nodes: List[_Node] = []
             if not passing:
@@ -415,32 +457,18 @@ class ConsensusDWFA:
                     )
                 scorer.free(node.handle)
                 # otherwise: dead end past all activations, drop the branch
-            elif len(passing) == 1:
-                # single extension: move the branch in place, no clone
-                consensus = node.consensus + bytes([passing[0]])
-                stats = scorer.push(node.handle, consensus)
-                node.consensus = consensus
-                node.stats = stats
-                new_nodes.append(node)
             else:
-                specs = []
-                children = []
                 for sym in passing:
-                    handle = scorer.clone(node.handle)
-                    consensus = node.consensus + bytes([sym])
-                    specs.append((handle, consensus))
-                    children.append(
+                    handle, stats = expansion[sym]
+                    new_nodes.append(
                         _Node(
-                            consensus,
+                            node.consensus + bytes([sym]),
                             handle,
                             list(node.active),
                             list(node.offsets),
-                            None,
+                            stats,
                         )
                     )
-                for child, stats in zip(children, scorer.push_many(specs)):
-                    child.stats = stats
-                    new_nodes.append(child)
                 scorer.free(node.handle)
 
             for child in new_nodes:
@@ -473,6 +501,53 @@ class ConsensusDWFA:
         return results
 
     # ------------------------------------------------------------------
+
+    def _nominate(self, scorer: WavefrontScorer, node: _Node) -> List[int]:
+        """Passing extension symbols for a node — a pure function of its
+        stats (so it can run at prefetch time with an identical result)."""
+        cfg = self.config
+        candidates = candidates_from_stats(
+            node.stats, scorer.symtab, cfg.wildcard
+        )
+        max_observed = max(candidates.values(), default=float(cfg.min_count))
+        active_threshold = min(float(cfg.min_count), max_observed)
+        return sorted(
+            sym for sym, count in candidates.items() if count >= active_threshold
+        )
+
+    def _prefetch_expansions(
+        self, scorer: WavefrontScorer, nodes: List[_Node]
+    ) -> None:
+        """Expand every listed node's children in one fused clone dispatch
+        plus one fused push dispatch, storing the results on the nodes."""
+        per_node_passing = []
+        clone_srcs: List[int] = []
+        for node in nodes:
+            passing = self._nominate(scorer, node)
+            per_node_passing.append(passing)
+            clone_srcs.extend([node.handle] * len(passing))
+        handles = scorer.clone_many(clone_srcs)
+        push_specs: List[Tuple[int, bytes]] = []
+        slots: List[List] = []
+        hi = 0
+        for node, passing in zip(nodes, per_node_passing):
+            expansion = {}
+            for sym in passing:
+                handle = handles[hi]
+                hi += 1
+                entry = [handle, None]
+                expansion[sym] = entry
+                push_specs.append((handle, node.consensus + bytes([sym])))
+                slots.append(entry)
+            node.prefetch = (passing, expansion)
+        for entry, stats in zip(slots, scorer.push_many(push_specs)):
+            entry[1] = stats
+
+    def _drop_prefetch(self, scorer: WavefrontScorer, node: _Node) -> None:
+        if node.prefetch is not None:
+            for handle, _stats in node.prefetch[1].values():
+                scorer.free(handle)
+            node.prefetch = None
 
     def _reached_end(self, node: _Node, require_all: bool) -> bool:
         flags = [

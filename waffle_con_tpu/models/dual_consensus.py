@@ -19,6 +19,7 @@ import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.models.consensus import (
+    RUN_SIM_CAP,
     Consensus,
     EngineError,
     candidates_from_stats,
@@ -101,6 +102,7 @@ class _DualNode:
         "offsets2",
         "stats1",
         "stats2",
+        "prefetch",
     )
 
     def __init__(self):
@@ -117,6 +119,10 @@ class _DualNode:
         self.offsets2: List[Optional[int]] = []
         self.stats1 = None
         self.stats2 = None
+        #: speculative expansion cache: ``(specs, children)`` built by a
+        #: fused multi-node dispatch before this node was popped (pure
+        #: cache — specs are a deterministic function of the stats)
+        self.prefetch = None
 
     # -- identity ------------------------------------------------------
     def key(self) -> Tuple:
@@ -374,47 +380,76 @@ class DualConsensusDWFA:
                 self._free_node(scorer, node)
                 continue
 
-            # -- device fast path: when this node is the whole frontier of
-            # its kind, let the scorer extend it through unambiguous
-            # stretches on device (see models/consensus.py for the budget
-            # argument; dual nodes step BOTH branches per iteration with
-            # on-device divergence pruning).  min_af == 0 keeps every vote
-            # threshold static; a locked side would stall the max-length
-            # bookkeeping, so those fall back to per-symbol flow.
+            # -- device fast path: extend the popped node through
+            # unambiguous stretches on device (dual nodes step BOTH
+            # branches per iteration with on-device divergence pruning).
+            # Engages only when this pop's own child spec is the single
+            # both-sides-extend (or single-symbol) case, while the node
+            # keeps winning pops (see models/consensus.py), with max_steps
+            # bounded by the exact tracker simulation.  min_af == 0 keeps
+            # every vote threshold static; a locked side would stall the
+            # max-length bookkeeping, so those fall back to per-symbol
+            # flow.
             farthest_kind = farthest_dual if node.is_dual else farthest_single
             kind_tracker = dual_tracker if node.is_dual else single_tracker
-            runnable = (
-                cfg.min_af == 0.0
-                and top_len >= farthest_kind
-                and (
-                    (
-                        node.is_dual
-                        and not node.lock1
-                        and not node.lock2
-                        and getattr(scorer, "run_extend_dual", None) is not None
-                    )
-                    or (
-                        not node.is_dual
-                        and getattr(scorer, "run_extend", None) is not None
-                    )
+            runnable = cfg.min_af == 0.0 and (
+                (
+                    node.is_dual
+                    and not node.lock1
+                    and not node.lock2
+                    and getattr(scorer, "run_extend_dual", None) is not None
+                )
+                or (
+                    not node.is_dual
+                    and getattr(scorer, "run_extend", None) is not None
                 )
             )
             if runnable:
+                specs_now = (
+                    node.prefetch[0]
+                    if node.prefetch is not None
+                    else self._build_specs(scorer, node)
+                )
+                if node.is_dual:
+                    runnable = (
+                        len(specs_now) == 1
+                        and specs_now[0][0] == "dual"
+                        and specs_now[0][1] is not None
+                        and specs_now[0][2] is not None
+                    )
+                else:
+                    runnable = len(specs_now) == 1 and specs_now[0][0] == "single"
+            if runnable:
                 best_other = pqueue.peek_priority()
-                run_budget = maximum_error
+                other_cost = 2**31 - 1
+                other_len = 0
                 if best_other is not None:
-                    run_budget = min(run_budget, -best_other[0] - 1)
-                if run_budget >= top_cost:
+                    other_cost = -best_other[0]
+                    other_len = best_other[1]
+                if top_cost < other_cost or (
+                    top_cost == other_cost and top_len > other_len
+                ):
                     next_act = min(
                         (l for l in activate_points if l > top_len), default=None
                     )
-                    max_steps = initial_size * 2 + 256
+                    max_steps = min(initial_size * 2 + 256, RUN_SIM_CAP)
                     if next_act is not None:
                         max_steps = min(max_steps, next_act - top_len - 1)
                     if max_steps >= 1:
-                        budget = (
-                            int(run_budget)
-                            if run_budget != math.inf
+                        max_steps = kind_tracker.simulate_run_bound(
+                            top_len,
+                            farthest_kind,
+                            dual_last_constraint
+                            if node.is_dual
+                            else single_last_constraint,
+                            cfg.max_queue_size,
+                            cfg.max_nodes_wo_constraint,
+                            max_steps,
+                        )
+                    if max_steps >= 1:
+                        me_budget = (
+                            int(maximum_error)
+                            if maximum_error != math.inf
                             else 2**31 - 1
                         )
                         l2 = cost is ConsensusCost.L2_DISTANCE
@@ -433,7 +468,9 @@ class DualConsensusDWFA:
                                 node.h2,
                                 node.consensus1,
                                 node.consensus2,
-                                budget,
+                                me_budget,
+                                other_cost,
+                                other_len,
                                 cfg.min_count,
                                 cfg.dual_max_ed_delta,
                                 active_min_count[top_len],
@@ -445,12 +482,16 @@ class DualConsensusDWFA:
                             steps, _code, app1, stats1 = scorer.run_extend(
                                 node.h1,
                                 node.consensus1,
-                                budget,
+                                me_budget,
+                                other_cost,
+                                other_len,
                                 cfg.min_count,
                                 l2,
                                 max_steps,
                             )
                         if steps > 0:
+                            # the branches advanced past the prefetched children
+                            self._drop_prefetch(scorer, node)
 
                             def extend_tables(length):
                                 if len(active_min_count) == length + 1:
@@ -608,6 +649,14 @@ class DualConsensusDWFA:
         if node.h2 is not None:
             scorer.free(node.h2)
         node.h1 = node.h2 = None
+        self._drop_prefetch(scorer, node)
+
+    def _drop_prefetch(self, scorer: WavefrontScorer, node: _DualNode) -> None:
+        if node.prefetch is not None:
+            _specs, children = node.prefetch
+            node.prefetch = None
+            for child in children:
+                self._free_node(scorer, child)
 
     def _activate_sequence(self, scorer, node: _DualNode, seq_index: int) -> None:
         cfg = self.config
@@ -735,16 +784,12 @@ class DualConsensusDWFA:
             tracker.remove(child.max_consensus_length())
             self._free_node(scorer, child)
 
-    def _expand(
-        self,
-        scorer,
-        node: _DualNode,
-        activate_points,
-        pqueue,
-        single_tracker,
-        dual_tracker,
-        cost,
-    ) -> None:
+    def _build_specs(
+        self, scorer, node: _DualNode
+    ) -> List[Tuple[str, Optional[int], Optional[int]]]:
+        """Decide every child of a node as a (kind, sym1, sym2) spec — a
+        pure function of the node's stats (so it can run at prefetch time
+        with an identical result)."""
         cfg = self.config
         wildcard = cfg.wildcard
         weighted = cfg.weighted_by_ed
@@ -756,7 +801,6 @@ class DualConsensusDWFA:
         max_observed1 = max(ec1.values(), default=float(min_count1))
         active_threshold1 = min(float(min_count1), max_observed1)
 
-        # -- phase 1: decide every child as a (kind, sym1, sym2) spec ----
         specs: List[Tuple[str, Optional[int], Optional[int]]] = []
         if node.is_dual:
             ec2 = node.candidates(False, scorer.symtab, wildcard, weighted)
@@ -822,27 +866,32 @@ class DualConsensusDWFA:
                     for i, (_nc1, c1) in enumerate(sorted_candidates)
                     for _nc2, c2 in sorted_candidates[i + 1 :]
                 )
-        if not specs:
-            return
+        return specs
 
-        # -- phase 2: one fused clone dispatch for every child branch ----
+    def _materialize_expansions(
+        self, scorer, nodes: List[_DualNode]
+    ) -> None:
+        """Build every listed node's children with ONE fused clone
+        dispatch and ONE fused push dispatch across all of them, storing
+        ``(specs, children)`` on each node's ``prefetch``."""
+        per_node_specs = [self._build_specs(scorer, node) for node in nodes]
+
         clone_srcs: List[int] = []
-        for kind, _a, _b in specs:
-            if kind == "dual":
-                clone_srcs += [node.h1, node.h2]
-            elif kind == "single":
-                clone_srcs += [node.h1]
-            else:  # split: both sides start from consensus1's state
-                clone_srcs += [node.h1, node.h1]
+        for node, specs in zip(nodes, per_node_specs):
+            for kind, _a, _b in specs:
+                if kind == "dual":
+                    clone_srcs += [node.h1, node.h2]
+                elif kind == "single":
+                    clone_srcs += [node.h1]
+                else:  # split: both sides start from consensus1's state
+                    clone_srcs += [node.h1, node.h1]
         handles = scorer.clone_many(clone_srcs)
 
-        # -- phase 3: build children; one fused push dispatch ------------
-        children: List[_DualNode] = []
         push_specs: List[Tuple[int, bytes]] = []
-        push_targets: List[Tuple[int, bool]] = []
+        push_targets: List[Tuple[_DualNode, bool]] = []
         hi = 0
 
-        def queue_push(ci: int, child: _DualNode, sym: int, side1: bool) -> None:
+        def queue_push(child: _DualNode, sym: int, side1: bool) -> None:
             if side1:
                 if child.lock1:
                     raise EngineError("Consensus 1 is locked, cannot modify")
@@ -853,61 +902,86 @@ class DualConsensusDWFA:
                     raise EngineError("Consensus 2 is locked, cannot modify")
                 child.consensus2 = child.consensus2 + bytes([sym])
                 push_specs.append((child.h2, child.consensus2))
-            push_targets.append((ci, side1))
+            push_targets.append((child, side1))
 
-        for ci, (kind, a, b) in enumerate(specs):
-            child = _DualNode()
-            child.consensus1 = node.consensus1
-            child.active1 = list(node.active1)
-            child.offsets1 = list(node.offsets1)
-            child.stats1 = node.stats1
-            if kind == "dual":
-                child.is_dual = True
-                child.lock1 = node.lock1
-                child.lock2 = node.lock2
-                child.h1, child.h2 = handles[hi], handles[hi + 1]
-                hi += 2
-                child.consensus2 = node.consensus2
-                child.active2 = list(node.active2)
-                child.offsets2 = list(node.offsets2)
-                child.stats2 = node.stats2
-                if a is not None:
-                    queue_push(ci, child, a, True)
-                else:
-                    child.lock1 = True
-                if b is not None:
-                    queue_push(ci, child, b, False)
-                else:
-                    child.lock2 = True
-            elif kind == "single":
-                child.h1 = handles[hi]
-                hi += 1
-                child.consensus2 = node.consensus2
-                child.active2 = list(node.active2)
-                child.offsets2 = list(node.offsets2)
-                queue_push(ci, child, a, True)
-            else:  # split (/root/reference/src/dual_consensus.rs:957-976)
-                check_invariant(a != b, "dual split needs distinct symbols")
-                child.is_dual = True
-                child.h1, child.h2 = handles[hi], handles[hi + 1]
-                hi += 2
-                child.consensus2 = node.consensus1
-                child.active2 = list(node.active1)
-                child.offsets2 = list(node.offsets1)
-                child.stats2 = node.stats1
-                queue_push(ci, child, a, True)
-                queue_push(ci, child, b, False)
-            children.append(child)
+        for node, specs in zip(nodes, per_node_specs):
+            children: List[_DualNode] = []
+            for kind, a, b in specs:
+                child = _DualNode()
+                child.consensus1 = node.consensus1
+                child.active1 = list(node.active1)
+                child.offsets1 = list(node.offsets1)
+                child.stats1 = node.stats1
+                if kind == "dual":
+                    child.is_dual = True
+                    child.lock1 = node.lock1
+                    child.lock2 = node.lock2
+                    child.h1, child.h2 = handles[hi], handles[hi + 1]
+                    hi += 2
+                    child.consensus2 = node.consensus2
+                    child.active2 = list(node.active2)
+                    child.offsets2 = list(node.offsets2)
+                    child.stats2 = node.stats2
+                    if a is not None:
+                        queue_push(child, a, True)
+                    else:
+                        child.lock1 = True
+                    if b is not None:
+                        queue_push(child, b, False)
+                    else:
+                        child.lock2 = True
+                elif kind == "single":
+                    child.h1 = handles[hi]
+                    hi += 1
+                    child.consensus2 = node.consensus2
+                    child.active2 = list(node.active2)
+                    child.offsets2 = list(node.offsets2)
+                    queue_push(child, a, True)
+                else:  # split (/root/reference/src/dual_consensus.rs:957-976)
+                    check_invariant(a != b, "dual split needs distinct symbols")
+                    child.is_dual = True
+                    child.h1, child.h2 = handles[hi], handles[hi + 1]
+                    hi += 2
+                    child.consensus2 = node.consensus1
+                    child.active2 = list(node.active1)
+                    child.offsets2 = list(node.offsets1)
+                    child.stats2 = node.stats1
+                    queue_push(child, a, True)
+                    queue_push(child, b, False)
+                children.append(child)
+            node.prefetch = (specs, children)
 
-        for (ci, side1), stats in zip(
+        for (child, side1), stats in zip(
             push_targets, scorer.push_many(push_specs)
         ):
             if side1:
-                children[ci].stats1 = stats
+                child.stats1 = stats
             else:
-                children[ci].stats2 = stats
+                child.stats2 = stats
 
-        # -- phase 4: activations, batched pruning, queueing -------------
+    def _expand(
+        self,
+        scorer,
+        node: _DualNode,
+        activate_points,
+        pqueue,
+        single_tracker,
+        dual_tracker,
+        cost,
+    ) -> None:
+        cfg = self.config
+
+        if node.prefetch is None:
+            peers = [
+                n
+                for n, _p in pqueue.peek_top(cfg.prefetch_width - 1)
+                if n.prefetch is None
+            ]
+            self._materialize_expansions(scorer, [node] + peers)
+        specs, children = node.prefetch
+        node.prefetch = None
+
+        # -- finishing (pop time): activations, batched pruning, queueing
         deactivations: List[Tuple[int, int]] = []
         for child in children:
             self._maybe_activate(scorer, child, activate_points)

@@ -336,18 +336,25 @@ def _j_finalize(state, h):
 
 @partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
 def _j_run(
-    state, reads, rlen, h, budget, min_count, l2, wc, et, max_steps,
-    num_symbols,
+    state, reads, rlen, h, me_budget, other_cost, other_len, min_count, l2,
+    wc, et, max_steps, num_symbols,
 ):
     """Device-resident multi-symbol extension: keep appending the unique
     passing candidate while the votes are exactly reproducible host-side
     (one tip symbol per read → integer counts), stopping at any event the
     host search must arbitrate.
 
+    The run continues only while the node would keep winning pops against
+    the best other queued entry ``(other_cost, other_len)`` under the
+    host's ``(-cost, len)`` priority — strictly cheaper, or equal cost
+    with a strictly longer consensus (full ties pop the earlier-inserted
+    queue entry first, so they stop the run) — and while the cost stays
+    within ``me_budget`` (the best finalized result so far).
+
     Stop codes: 1 = votes need host arbitration (non-one-hot, wildcard
     votes, or #passing != 1), 2 = some read reached its baseline end,
-    3 = node cost exceeded the budget, 4 = step limit, 5 = band overflow
-    (last push not committed).
+    3 = node would lose the next pop (budget/priority), 4 = step limit,
+    5 = band overflow (last push not committed).
 
     This is the TPU answer to the reference's symbol-at-a-time host loop:
     for clean stretches the consensus grows entirely on device, with one
@@ -411,11 +418,14 @@ def _j_run(
         # early-termination runs freeze a reached read rather than ending
         # the search, so only stop when the node as a whole may be complete
         reached_stop = jnp.where(et, (reached | ~act).all(), reached.any())
+        wins_pop = (total < other_cost) | (
+            (total == other_cost) & (clen > other_len)
+        )
         code = jnp.where(
             reached_stop,
             2,
             jnp.where(
-                total > budget,
+                (total > me_budget) | ~wins_pop,
                 3,
                 jnp.where(
                     dirty,
@@ -502,8 +512,8 @@ def _dual_votes(occ, split, w, wc, weighted):
 
 @partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
 def _j_run_dual(
-    state, reads, rlen, ha, hb, budget, min_count, delta, imb_min,
-    l2, weighted, wc, et, max_steps, num_symbols,
+    state, reads, rlen, ha, hb, me_budget, other_cost, other_len, min_count,
+    delta, imb_min, l2, weighted, wc, et, max_steps, num_symbols,
 ):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
@@ -515,9 +525,10 @@ def _j_run_dual(
 
     Stop codes: 1 = host arbitration (ambiguous votes, != 1 passing
     symbol on a side, a side ran out of candidates, or a side finished),
-    2 = some read reached its baseline end, 3 = cost exceeded budget,
-    4 = step limit, 5 = band overflow (last step not committed),
-    6 = committed step made the node imbalanced (host pop discards it).
+    2 = some read reached its baseline end, 3 = node would lose the next
+    pop (budget/priority — see ``_j_run``), 4 = step limit, 5 = band
+    overflow (last step not committed), 6 = committed step made the node
+    imbalanced (host pop discards it).
 
     This is the dual twin of ``_j_run`` and the answer to the reference's
     quadratic dual extension loop
@@ -613,12 +624,16 @@ def _j_run_dual(
             et, (reachedb | ~actb).all(), (actb & reachedb).any()
         )
         reached_stop = jnp.where(et, reached_read.all(), reached_read.any())
+        cur_len = jnp.maximum(clena, clenb)
+        wins_pop = (total < other_cost) | (
+            (total == other_cost) & (cur_len > other_len)
+        )
 
         code = jnp.where(
             reached_stop,
             2,
             jnp.where(
-                total > budget,
+                (total > me_budget) | ~wins_pop,
                 3,
                 jnp.where(
                     dirty_a | dirty_b | fin_a | fin_b | cost_overflow,
@@ -1018,7 +1033,9 @@ class JaxScorer(WavefrontScorer):
         self,
         h: int,
         consensus: bytes,
-        budget: int,
+        me_budget: int,
+        other_cost: int,
+        other_len: int,
         min_count: int,
         l2: bool,
         max_steps: int,
@@ -1037,7 +1054,9 @@ class JaxScorer(WavefrontScorer):
             self._reads,
             self._rlen,
             slot,
-            jnp.int32(min(budget, 2**31 - 1)),
+            jnp.int32(min(me_budget, 2**31 - 1)),
+            jnp.int32(min(other_cost, 2**31 - 1)),
+            jnp.int32(other_len),
             jnp.int32(min_count),
             jnp.bool_(l2),
             self._wc,
@@ -1066,7 +1085,9 @@ class JaxScorer(WavefrontScorer):
         h2: int,
         consensus1: bytes,
         consensus2: bytes,
-        budget: int,
+        me_budget: int,
+        other_cost: int,
+        other_len: int,
         min_count: int,
         ed_delta: int,
         imb_min: int,
@@ -1090,7 +1111,9 @@ class JaxScorer(WavefrontScorer):
             self._rlen,
             s1,
             s2,
-            jnp.int32(min(budget, 2**31 - 1)),
+            jnp.int32(min(me_budget, 2**31 - 1)),
+            jnp.int32(min(other_cost, 2**31 - 1)),
+            jnp.int32(other_len),
             jnp.int32(min_count),
             jnp.int32(ed_delta),
             jnp.int32(imb_min),

@@ -89,6 +89,60 @@ class PQueueTracker:
     def threshold(self) -> int:
         return self._threshold
 
+    def simulate_run_bound(
+        self,
+        start_len: int,
+        farthest: int,
+        last_constraint: int,
+        max_queue_size: int,
+        max_nodes_wo_constraint: int,
+        max_steps: int,
+    ) -> int:
+        """Exact preview of how many consecutive frontier pops a
+        just-popped node of length ``start_len`` could survive before the
+        threshold or per-length capacity bookkeeping would prune it,
+        assuming no other queue activity — which is exactly the state of
+        affairs during a device-resident extension run.  Lets the run
+        engage on nodes *behind* the farthest frontier without risking a
+        replayed step the real search would have pruned."""
+        lc = list(self._length_counts)
+        pc = list(self._processed_counts)
+        total = self._total_count
+        thr = self._threshold
+        cap = self._capacity_per_size
+        for j in range(max_steps):
+            length = start_len + j
+            if j > 0:
+                while (
+                    total > max_queue_size
+                    or last_constraint >= max_nodes_wo_constraint
+                ) and thr < farthest:
+                    if thr < len(lc):
+                        total -= lc[thr]
+                    thr += 1
+                    last_constraint = 0
+                if length < thr:
+                    return j
+                if length < len(pc) and pc[length] >= cap:
+                    return j
+                # remove(length): the node leaves the queue for this pop
+                if length < len(lc) and lc[length] > 0:
+                    lc[length] -= 1
+                    if length >= thr:
+                        total -= 1
+            farthest = max(farthest, length)
+            last_constraint += 1
+            while length >= len(pc):
+                pc.append(0)
+            pc[length] += 1
+            # insert(length + 1): the extended node re-enters the queue
+            while length + 1 >= len(lc):
+                lc.append(0)
+            lc[length + 1] += 1
+            if length + 1 >= thr:
+                total += 1
+        return max_steps
+
     def occupancy(self, value: int) -> int:
         if value >= len(self._length_counts):
             return 0
@@ -139,6 +193,21 @@ class SetPriorityQueue:
                 return self._live[key][0]
             heapq.heappop(self._heap)
         return None
+
+    def peek_top(self, k: int) -> List[Tuple[Any, Tuple]]:
+        """Up to ``k`` best ``(item, priority)`` pairs in pop order,
+        without removing them (used for speculative expansion)."""
+        out: List[Tuple[Any, Tuple]] = []
+        if k <= 0:
+            return out
+        for _neg, _seq, key in heapq.nsmallest(k, self._heap):
+            entry = self._live.get(key)
+            if entry is None:  # pragma: no cover - defensive (no stale paths)
+                continue
+            out.append((entry[1], entry[0]))
+            if len(out) == k:
+                break
+        return out
 
     def pop(self) -> Tuple[Any, Any]:
         """Remove and return ``(item, priority)`` of the best entry."""
