@@ -93,6 +93,14 @@ class CdwfaConfig:
     #: Engines are sharding-agnostic: results are identical on 1 or N
     #: chips.  Framework extension beyond the reference config.
     mesh_shards: int = 0
+    #: Seed the jax scorer's band half-width (``e_max``) from the caller's
+    #: error model instead of growing it from a small default: a value of
+    #: ``margin + 2 * error_rate * max_read_len`` makes band-growth
+    #: replays (and their per-width kernel recompiles) vanish for
+    #: workloads whose noise level is known, e.g. HiFi reads.  ``None``
+    #: keeps the grow-on-demand default.  Rounded up to a power of two.
+    #: Framework extension beyond the reference config.
+    initial_band: Optional[int] = None
     #: Speculatively expand up to this many queue nodes per scorer
     #: dispatch (frontier-synchronous batching): the children of the
     #: popped node and of the next best queued nodes are cloned and
@@ -110,6 +118,8 @@ class CdwfaConfig:
             raise ValueError("mesh_shards requires the jax backend")
         if self.prefetch_width < 1:
             raise ValueError("prefetch_width must be >= 1")
+        if self.initial_band is not None and self.initial_band < 1:
+            raise ValueError("initial_band must be >= 1")
 
 
 class CdwfaConfigBuilder:

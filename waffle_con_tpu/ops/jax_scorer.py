@@ -62,13 +62,17 @@ from jax import lax
 from waffle_con_tpu.config import CdwfaConfig
 from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
 
-INF = jnp.int32(1 << 20)
+#: Numpy (not jnp) module constants: a ``jnp`` scalar here would (a) force
+#: backend init at import time and (b) on this platform every eagerly
+#: dispatched tiny op costs 60-350ms wall even on compile-cache hits, so
+#: everything outside ``jit`` stays numpy and becomes a traced literal.
+INF = np.int32(1 << 20)
 
 #: f32-vs-f64 vote-sum comparison margin for the device run loops: decisions
 #: with margins under this are host events.  Conservatively above the worst
 #: accumulated f32 error for thousands of reads (exact one-hot integer votes
 #: bypass it entirely, so clean stretches never false-stop).
-VOTE_EPS = jnp.float32(1e-2)
+VOTE_EPS = np.float32(1e-2)
 
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
@@ -186,9 +190,12 @@ def _j_clone(state, src, dst):
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _j_clone_batch(state, srcs, dsts):
-    """Copy a batch of branch slots (``dsts`` padded with repeats of
+def _j_clone_batch(state, srcs_dsts):
+    """Copy a batch of branch slots (``srcs_dsts`` is ``[2, npad] int32``
+    — source row then destination row; ``dsts`` padded with repeats of
     ``dsts[0]`` are fine: duplicate writes carry identical rows)."""
+    srcs = srcs_dsts[0]
+    dsts = srcs_dsts[1]
     out = dict(state)
     for name in ("D", "e", "rmin", "er", "off", "act", "cons", "clen"):
         out[name] = state[name].at[dsts].set(state[name][srcs])
@@ -203,17 +210,41 @@ def _j_deactivate(state, h, read_index):
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _j_deactivate_batch(state, hs, ridx):
+def _j_deactivate_batch(state, hs_ridx):
     out = dict(state)
-    out["act"] = state["act"].at[hs, ridx].set(False)
+    out["act"] = state["act"].at[hs_ridx[0], hs_ridx[1]].set(False)
     return out
 
 
+@partial(jax.jit, static_argnames=("new_b",))
+def _j_grow_slots(state, new_b: int):
+    """Double the branch-slot axis in one fused dispatch (the eager
+    per-array ``at[].set`` path would cost 8 separate device ops)."""
+    out = {}
+    for name, arr in state.items():
+        pad_shape = (new_b - arr.shape[0],) + arr.shape[1:]
+        fill = INF if name in ("D", "rmin", "er") else 0
+        pad = jnp.full(pad_shape, fill, dtype=arr.dtype)
+        out[name] = jnp.concatenate([arr, pad], axis=0)
+    return out
+
+
+@partial(jax.jit, static_argnames=("new_c",))
+def _j_grow_cons(state, new_c: int):
+    """Double the consensus-capacity axis in one fused dispatch."""
+    cons = state["cons"]
+    pad = jnp.zeros((cons.shape[0], new_c - cons.shape[1]), dtype=cons.dtype)
+    return dict(state, cons=jnp.concatenate([cons, pad], axis=1))
+
+
 @partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
-def _j_push_batch(state, reads, rlen, hs, syms, wc, et, num_symbols):
-    """Advance a batch of branch slots by one symbol each (``hs`` may
-    contain duplicate padding entries as long as their ``syms`` agree).
-    Returns (state, stats-per-branch, overflow)."""
+def _j_push_batch(state, reads, rlen, hs_syms, wc, et, num_symbols):
+    """Advance a batch of branch slots by one symbol each (``hs_syms`` is
+    ``[2, npad] int32`` — slot row then symbol row, packed into one host
+    upload; duplicate padding slots are fine as long as their symbols
+    agree).  Returns (state, stats-per-branch, overflow)."""
+    hs = hs_syms[0]
+    syms = hs_syms[1]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -279,9 +310,14 @@ def _j_stats(state, reads, rlen, h, num_symbols):
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _j_activate(state, reads, rlen, h, read_index, offset, wc, et):
+def _j_activate(state, reads, rlen, params, wc, et):
     """Begin tracking one read at consensus offset ``offset``: fresh column
-    at ``j == offset``, then catch up to the branch's current length."""
+    at ``j == offset``, then catch up to the branch's current length.
+    ``params`` is ``[3] int32``: (slot, read_index, offset) — one host
+    upload."""
+    h = params[0]
+    read_index = params[1]
+    offset = params[2]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     clen = state["clen"][h]
@@ -335,10 +371,7 @@ def _j_finalize(state, h):
 
 
 @partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
-def _j_run(
-    state, reads, rlen, h, me_budget, other_cost, other_len, min_count, l2,
-    wc, et, max_steps, num_symbols,
-):
+def _j_run(state, reads, rlen, params, wc, et, num_symbols):
     """Device-resident multi-symbol extension: keep appending the unique
     passing candidate while the votes are exactly reproducible host-side
     (one tip symbol per read → integer counts), stopping at any event the
@@ -359,7 +392,17 @@ def _j_run(
     This is the TPU answer to the reference's symbol-at-a-time host loop:
     for clean stretches the consensus grows entirely on device, with one
     host round-trip per *event* instead of per base.
+
+    ``params`` is ``[7] int32`` — (slot, me_budget, other_cost, other_len,
+    min_count, l2, max_steps) — packed into a single host upload.
     """
+    h = params[0]
+    me_budget = params[1]
+    other_cost = params[2]
+    other_len = params[3]
+    min_count = params[4]
+    l2 = params[5].astype(bool)
+    max_steps = params[6]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -476,7 +519,7 @@ def _j_run(
     out["er"] = state["er"].at[h].set(er)
     out["cons"] = state["cons"].at[h].set(cons)
     out["clen"] = state["clen"].at[h].set(clen)
-    return out, steps, code, stats
+    return out, steps, code, stats, cons
 
 
 def _dual_votes(occ, split, w, wc, weighted):
@@ -511,10 +554,7 @@ def _dual_votes(occ, split, w, wc, weighted):
 
 
 @partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
-def _j_run_dual(
-    state, reads, rlen, ha, hb, me_budget, other_cost, other_len, min_count,
-    delta, imb_min, l2, weighted, wc, et, max_steps, num_symbols,
-):
+def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
     with divergence pruning (``dual_max_ed_delta``) applied on device
@@ -535,7 +575,22 @@ def _j_run_dual(
     (``/root/reference/src/dual_consensus.rs:606-734``): clean dual
     stretches cost one host round-trip per *event*, not ~5 dispatches per
     appended base.
+
+    ``params`` is ``[11] int32`` — (slot_a, slot_b, me_budget, other_cost,
+    other_len, min_count, dual_max_ed_delta, imb_min, l2, weighted,
+    max_steps) — packed into a single host upload.
     """
+    ha = params[0]
+    hb = params[1]
+    me_budget = params[2]
+    other_cost = params[3]
+    other_len = params[4]
+    min_count = params[5]
+    delta = params[6]
+    imb_min = params[7]
+    l2 = params[8].astype(bool)
+    weighted = params[9].astype(bool)
+    max_steps = params[10]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -712,7 +767,7 @@ def _j_run_dual(
     out["act"] = state["act"].at[ha].set(acta).at[hb].set(actb)
     out["cons"] = state["cons"].at[ha].set(consa).at[hb].set(consb)
     out["clen"] = state["clen"].at[ha].set(clena).at[hb].set(clenb)
-    return out, steps, code, stats_a, stats_b, acta, actb
+    return out, steps, code, stats_a, stats_b, acta, actb, consa, consb
 
 
 @partial(jax.jit, static_argnames=("W",))
@@ -768,36 +823,55 @@ class JaxScorer(WavefrontScorer):
 
     INITIAL_E = 8
     INITIAL_SLOTS = 16
+    #: geometry floors: quantizing small fixtures up to shared shapes means
+    #: different datasets reuse the same compiled kernels (on this platform
+    #: per-shape compile-cache traffic dominates small-fixture wall time;
+    #: the extra vector lanes are noise)
+    MIN_R = 16
+    MIN_L = 256
+    MIN_C = 512
+    #: tip-vote tables are padded to at least this many dense symbols so
+    #: 4-symbol and 5-symbol (wildcarded) alphabets share compiled shapes
+    MIN_A = 8
 
     def __init__(self, reads: Sequence[bytes], config: CdwfaConfig) -> None:
         super().__init__(reads, config)
         n = len(self.reads)
-        self._R = _next_pow2(max(n, 1))
+        self._R = max(_next_pow2(max(n, 1)), self.MIN_R)
         ms = config.mesh_shards or 1
         if self._R % ms:
             self._R = ms * ((self._R + ms - 1) // ms)
         self._shardings = None  # installed by parallel.shard_scorer
         max_len = max((len(r) for r in self.reads), default=1)
-        self._L = _next_pow2(max(max_len, 1))
+        self._L = max(_next_pow2(max(max_len, 1)), self.MIN_L)
+        self._A = max(_next_pow2(max(self.num_symbols, 1)), self.MIN_A)
 
         reads_arr = np.full((self._R, self._L), -1, dtype=np.int32)
         rlen = np.zeros(self._R, dtype=np.int32)
         for i, r in enumerate(self.reads):
             reads_arr[i, : len(r)] = [self.sym_id[b] for b in r]
             rlen[i] = len(r)
-        self._reads = jnp.asarray(reads_arr)
-        self._rlen = jnp.asarray(rlen)
+        self._reads = jax.device_put(reads_arr)
+        self._rlen = jax.device_put(rlen)
 
-        self._wc = jnp.int32(
-            self.sym_id.get(config.wildcard, -2)
-            if config.wildcard is not None
-            else -2
+        # per-engine constants staged on device ONCE: passing a live device
+        # array as a jit argument is free, while a fresh numpy scalar is a
+        # separate host->device upload on every call
+        self._wc = jax.device_put(
+            np.int32(
+                self.sym_id.get(config.wildcard, -2)
+                if config.wildcard is not None
+                else -2
+            )
         )
-        self._et = jnp.bool_(config.allow_early_termination)
+        self._et = jax.device_put(np.bool_(config.allow_early_termination))
 
-        self._E = self.INITIAL_E
+        if config.initial_band is not None:
+            self._E = _next_pow2(int(config.initial_band), self.INITIAL_E)
+        else:
+            self._E = self.INITIAL_E
         self._B = self.INITIAL_SLOTS
-        self._C = _next_pow2(max_len + 64)
+        self._C = max(_next_pow2(max_len + 64), self.MIN_C)
         self._state = self._blank_state()
         self._free: List[int] = list(range(self._B))
         self._next_handle = 0
@@ -830,16 +904,19 @@ class JaxScorer(WavefrontScorer):
         return 2 * self._E + 2
 
     def _blank_state(self):
-        return {
-            "D": jnp.full((self._B, self._R, self._W), INF, dtype=jnp.int32),
-            "e": jnp.zeros((self._B, self._R), dtype=jnp.int32),
-            "rmin": jnp.full((self._B, self._R), INF, dtype=jnp.int32),
-            "er": jnp.full((self._B, self._R), INF, dtype=jnp.int32),
-            "off": jnp.zeros((self._B, self._R), dtype=jnp.int32),
-            "act": jnp.zeros((self._B, self._R), dtype=bool),
-            "cons": jnp.zeros((self._B, self._C), dtype=jnp.int32),
-            "clen": jnp.zeros((self._B,), dtype=jnp.int32),
+        # built host-side and transferred in one device_put (a jnp.full /
+        # jnp.zeros here would each dispatch a tiny compiled fill op)
+        host = {
+            "D": np.full((self._B, self._R, self._W), INF, dtype=np.int32),
+            "e": np.zeros((self._B, self._R), dtype=np.int32),
+            "rmin": np.full((self._B, self._R), INF, dtype=np.int32),
+            "er": np.full((self._B, self._R), INF, dtype=np.int32),
+            "off": np.zeros((self._B, self._R), dtype=np.int32),
+            "act": np.zeros((self._B, self._R), dtype=bool),
+            "cons": np.zeros((self._B, self._C), dtype=np.int32),
+            "clen": np.zeros((self._B,), dtype=np.int32),
         }
+        return jax.device_put(host)
 
     def _place(self) -> None:
         """Re-apply the mesh sharding (if any) after a geometry change —
@@ -868,25 +945,13 @@ class JaxScorer(WavefrontScorer):
     def _grow_slots(self) -> None:
         old_b = self._B
         self._B *= 2
-        out = {}
-        for name, arr in self._state.items():
-            shape = (self._B,) + arr.shape[1:]
-            if name in ("D", "rmin", "er"):
-                grown = jnp.full(shape, INF, dtype=arr.dtype)
-            else:
-                grown = jnp.zeros(shape, dtype=arr.dtype)
-            out[name] = grown.at[:old_b].set(arr)
-        self._state = out
+        self._state = _j_grow_slots(self._state, new_b=self._B)
         self._place()
         self._free.extend(range(old_b, self._B))
 
     def _grow_cons(self) -> None:
-        old_c = self._C
         self._C *= 2
-        cons = jnp.zeros((self._B, self._C), dtype=jnp.int32)
-        self._state = dict(
-            self._state, cons=cons.at[:, :old_c].set(self._state["cons"])
-        )
+        self._state = _j_grow_cons(self._state, new_c=self._C)
         self._place()
 
     def _alloc(self) -> Tuple[int, int]:
@@ -904,14 +969,14 @@ class JaxScorer(WavefrontScorer):
         handle, slot = self._alloc()
         act = np.zeros(self._R, dtype=bool)
         act[: len(active)] = active
-        self._state = _j_root(self._state, self._rlen, slot, jnp.asarray(act))
+        self._state = _j_root(self._state, self._rlen, np.int32(slot), act)
         return handle
 
     def clone(self, h: int) -> int:
         self.counters["clone_calls"] += 1
         src = self._slot_of[h]
         handle, dst = self._alloc()
-        self._state = _j_clone(self._state, src, dst)
+        self._state = _j_clone(self._state, np.int32(src), np.int32(dst))
         return handle
 
     def clone_many(self, hs: List[int]) -> List[int]:
@@ -927,9 +992,7 @@ class JaxScorer(WavefrontScorer):
         srcs += [srcs[0]] * (npad - len(hs))
         dsts += [dsts[0]] * (npad - len(hs))
         self._state = _j_clone_batch(
-            self._state,
-            jnp.asarray(srcs, dtype=jnp.int32),
-            jnp.asarray(dsts, dtype=jnp.int32),
+            self._state, np.asarray([srcs, dsts], dtype=np.int32)
         )
         return handles
 
@@ -959,33 +1022,28 @@ class JaxScorer(WavefrontScorer):
         syms = [self.sym_id[consensus[-1]] for _, consensus in specs]
         slots += [slots[0]] * (npad - n)
         syms += [syms[0]] * (npad - n)
+        packed = np.asarray([slots, syms], dtype=np.int32)
         while True:
             state, stats, overflow = _j_push_batch(
-                self._state,
-                self._reads,
-                self._rlen,
-                jnp.asarray(slots, dtype=jnp.int32),
-                jnp.asarray(syms, dtype=jnp.int32),
-                self._wc,
-                self._et,
-                self.num_symbols,
+                self._state, self._reads, self._rlen, packed,
+                self._wc, self._et, self._A,
             )
             self._state = state
-            if bool(overflow):
+            stats_np, ovf = jax.device_get((stats, overflow))
+            if bool(ovf):
                 self._grow_e()
                 continue
-            eds, occ, split, reached = stats
-            return [
-                self._to_host((eds[i], occ[i], split[i], reached[i]))
-                for i in range(n)
-            ]
+            return self._stats_rows(stats_np, n)
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
         self.counters["stats_calls"] += 1
         slot = self._slot_of[h]
-        return self._to_host(
-            _j_stats(
-                self._state, self._reads, self._rlen, slot, self.num_symbols
+        return self._stats_np(
+            jax.device_get(
+                _j_stats(
+                    self._state, self._reads, self._rlen, np.int32(slot),
+                    self._A,
+                )
             )
         )
 
@@ -994,16 +1052,11 @@ class JaxScorer(WavefrontScorer):
     ) -> None:
         self.counters["activate_calls"] += 1
         slot = self._slot_of[h]
+        params = np.asarray([slot, read_index, offset], dtype=np.int32)
         while True:
             state, overflow = _j_activate(
-                self._state,
-                self._reads,
-                self._rlen,
-                slot,
-                jnp.int32(read_index),
-                jnp.int32(offset),
-                self._wc,
-                self._et,
+                self._state, self._reads, self._rlen, params,
+                self._wc, self._et,
             )
             self._state = state
             if bool(overflow):
@@ -1013,7 +1066,9 @@ class JaxScorer(WavefrontScorer):
 
     def deactivate(self, h: int, read_index: int) -> None:
         slot = self._slot_of[h]
-        self._state = _j_deactivate(self._state, slot, jnp.int32(read_index))
+        self._state = _j_deactivate(
+            self._state, np.int32(slot), np.int32(read_index)
+        )
 
     def deactivate_many(self, pairs) -> None:
         if not pairs:
@@ -1024,9 +1079,7 @@ class JaxScorer(WavefrontScorer):
         hs += [hs[0]] * (npad - len(pairs))
         ridx += [ridx[0]] * (npad - len(pairs))
         self._state = _j_deactivate_batch(
-            self._state,
-            jnp.asarray(hs, dtype=jnp.int32),
-            jnp.asarray(ridx, dtype=jnp.int32),
+            self._state, np.asarray([hs, ridx], dtype=np.int32)
         )
 
     def run_extend(
@@ -1049,35 +1102,37 @@ class JaxScorer(WavefrontScorer):
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
-        state, steps, code, stats = _j_run(
-            self._state,
-            self._reads,
-            self._rlen,
-            slot,
-            jnp.int32(min(me_budget, 2**31 - 1)),
-            jnp.int32(min(other_cost, 2**31 - 1)),
-            jnp.int32(other_len),
-            jnp.int32(min_count),
-            jnp.bool_(l2),
-            self._wc,
-            self._et,
-            jnp.int32(max_steps),
-            self.num_symbols,
+        params = np.asarray(
+            [
+                slot,
+                min(me_budget, 2**31 - 1),
+                min(other_cost, 2**31 - 1),
+                other_len,
+                min_count,
+                int(l2),
+                max_steps,
+            ],
+            dtype=np.int32,
+        )
+        state, steps, code, stats, cons_row = _j_run(
+            self._state, self._reads, self._rlen, params,
+            self._wc, self._et, self._A,
+        )
+        self._state = state
+        steps, code, stats_np, cons_np = jax.device_get(
+            (steps, code, stats, cons_row)
         )
         steps = int(steps)
         code = int(code)
         self.counters["run_calls"] += 1
         self.counters["run_steps"] += steps
-        self._state = state
         appended = b""
         if steps:
-            ids = np.asarray(
-                state["cons"][slot, len(consensus) : len(consensus) + steps]
-            )
-            appended = bytes(int(self.symtab[i]) for i in ids)
+            ids = cons_np[len(consensus) : len(consensus) + steps]
+            appended = self.symtab[ids].astype(np.uint8).tobytes()
         if code == 5:
             self._grow_e()
-        return steps, code, appended, self._to_host(stats)
+        return steps, code, appended, self._stats_np(stats_np)
 
     def run_extend_dual(
         self,
@@ -1105,41 +1160,46 @@ class JaxScorer(WavefrontScorer):
         need = max(len(consensus1), len(consensus2)) + max_steps + 2
         while need >= self._C:
             self._grow_cons()
-        state, steps, code, stats1, stats2, act1, act2 = _j_run_dual(
-            self._state,
-            self._reads,
-            self._rlen,
-            s1,
-            s2,
-            jnp.int32(min(me_budget, 2**31 - 1)),
-            jnp.int32(min(other_cost, 2**31 - 1)),
-            jnp.int32(other_len),
-            jnp.int32(min_count),
-            jnp.int32(ed_delta),
-            jnp.int32(imb_min),
-            jnp.bool_(l2),
-            jnp.bool_(weighted),
-            self._wc,
-            self._et,
-            jnp.int32(max_steps),
-            self.num_symbols,
+        params = np.asarray(
+            [
+                s1,
+                s2,
+                min(me_budget, 2**31 - 1),
+                min(other_cost, 2**31 - 1),
+                other_len,
+                min_count,
+                ed_delta,
+                imb_min,
+                int(l2),
+                int(weighted),
+                max_steps,
+            ],
+            dtype=np.int32,
+        )
+        state, steps, code, stats1, stats2, act1, act2, consa, consb = (
+            _j_run_dual(
+                self._state, self._reads, self._rlen, params,
+                self._wc, self._et, self._A,
+            )
+        )
+        self._state = state
+        (steps, code, stats1_np, stats2_np, act1_np, act2_np,
+         consa_np, consb_np) = jax.device_get(
+            (steps, code, stats1, stats2, act1, act2, consa, consb)
         )
         steps = int(steps)
         code = int(code)
         self.counters["run_dual_calls"] += 1
         self.counters["run_dual_steps"] += steps
-        self._state = state
 
-        def appended(slot, consensus):
+        def appended(cons_np, consensus):
             if not steps:
                 return b""
-            ids = np.asarray(
-                state["cons"][slot, len(consensus) : len(consensus) + steps]
-            )
-            return bytes(int(self.symtab[i]) for i in ids)
+            ids = cons_np[len(consensus) : len(consensus) + steps]
+            return self.symtab[ids].astype(np.uint8).tobytes()
 
-        app1 = appended(s1, consensus1)
-        app2 = appended(s2, consensus2)
+        app1 = appended(consa_np, consensus1)
+        app2 = appended(consb_np, consensus2)
         if code == 5:
             self._grow_e()
         n = self.num_reads
@@ -1148,30 +1208,43 @@ class JaxScorer(WavefrontScorer):
             code,
             app1,
             app2,
-            self._to_host(stats1),
-            self._to_host(stats2),
-            np.asarray(act1[:n]),
-            np.asarray(act2[:n]),
+            self._stats_np(stats1_np),
+            self._stats_np(stats2_np),
+            act1_np[:n],
+            act2_np[:n],
         )
 
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
         self.counters["finalize_calls"] += 1
         slot = self._slot_of[h]
         while True:
-            eds, overflow = _j_finalize(self._state, slot)
-            if bool(overflow):
+            eds, overflow = _j_finalize(self._state, np.int32(slot))
+            eds_np, ovf = jax.device_get((eds, overflow))
+            if bool(ovf):
                 self._grow_e()
                 continue
-            return np.asarray(eds[: self.num_reads], dtype=np.int64)
+            return eds_np[: self.num_reads].astype(np.int64)
 
     # -----------------------------------------------------------------
 
-    def _to_host(self, stats) -> BranchStats:
-        eds, occ, split, reached = stats
+    def _stats_np(self, stats_np) -> BranchStats:
+        """Host-array stats -> :class:`BranchStats`, slicing read padding
+        and alphabet padding away.  Input must already be numpy (ONE
+        ``jax.device_get`` per scorer call — per-element indexing of live
+        device arrays would dispatch a tiny gather op each time)."""
+        eds, occ, split, reached = stats_np
         n = self.num_reads
+        a = self.num_symbols
         return BranchStats(
-            np.asarray(eds[:n], dtype=np.int64),
-            np.asarray(occ[:n], dtype=np.int64),
-            np.asarray(split[:n], dtype=np.int64),
-            np.asarray(reached[:n]),
+            eds[:n].astype(np.int64),
+            occ[:n, :a].astype(np.int64),
+            split[:n].astype(np.int64),
+            reached[:n],
         )
+
+    def _stats_rows(self, stats_np, count: int) -> List[BranchStats]:
+        eds, occ, split, reached = stats_np
+        return [
+            self._stats_np((eds[i], occ[i], split[i], reached[i]))
+            for i in range(count)
+        ]
