@@ -294,3 +294,13 @@ def test_multi_err_recovery_jax_backend():
     for got_chain, want_chain in zip(result.consensuses, expected.consensuses):
         for got, want in zip(got_chain, want_chain):
             assert got.sequence == want.sequence
+
+
+def test_push_many_duplicate_handle_guard():
+    """Duplicate handles in one push batch would race in the scatter;
+    the scorer must reject them loudly (VERDICT r3 weak #7)."""
+    cfg = CdwfaConfigBuilder().backend("jax").build()
+    jx = JaxScorer([b"ACGT", b"ACGT"], cfg)
+    h = jx.root(np.array([True, True]))
+    with pytest.raises(ValueError, match="duplicate branch handles"):
+        jx.push_many([(h, b"A"), (h, b"C")])

@@ -137,3 +137,17 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     mod.dryrun_multichip(8)
+
+
+def test_shard_scorer_rejects_unknown_axis():
+    """Axis-name validation (ADVICE r2): a mesh without the requested
+    read axis must fail loudly, not silently shard over all devices."""
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+    from waffle_con_tpu.parallel import make_mesh
+    from waffle_con_tpu.parallel.mesh import shard_scorer
+
+    cfg = CdwfaConfigBuilder().backend("jax").build()
+    jx = JaxScorer([b"ACGT"] * 8, cfg)
+    mesh = make_mesh(2, axis_names=("data",))
+    with pytest.raises(ValueError, match="no axis 'read'"):
+        shard_scorer(jx, mesh)

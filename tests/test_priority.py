@@ -137,3 +137,25 @@ def test_priority_002():
 
 def test_priority_003():
     run_fixture("priority_003", True)
+
+
+def test_jax_backend_shares_one_scorer_per_level():
+    """VERDICT r3 #4: on the jax backend the priority engine must build
+    ONE device scorer per chain level per consensus() call, sharing it
+    across every worklist group via SubsetScorer views."""
+    cfg = CdwfaConfigBuilder().min_count(1).backend("jax").build()
+    engine = PriorityConsensusDWFA(cfg)
+    # two levels; level 1 splits into two groups -> 3 dual runs at least
+    chains = [
+        [b"ACGTACGT", b"AAAACCCC"],
+        [b"ACGTACGT", b"AAAACCCC"],
+        [b"ACGTACGT", b"GGGGTTTT"],
+        [b"ACGTACGT", b"GGGGTTTT"],
+    ]
+    for chain in chains:
+        engine.add_sequence_chain(chain)
+    result = engine.consensus()
+    assert len(result.consensuses) == 2
+    stats = engine.last_search_stats
+    assert stats["scorer_constructions"] == 2  # == number of levels
+    assert stats["scorer_counters"].get("push_calls", 0) > 0

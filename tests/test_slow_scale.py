@@ -1,0 +1,74 @@
+"""North-star-scale parity under the ``slow`` tier (VERDICT r3 #7).
+
+Run with ``RUN_SLOW=1 python -m pytest tests/test_slow_scale.py`` (or
+``-m slow``).  These exercise exactly what bench.py claims: generated
+HiFi-like workloads at benchmark scale, jax backend vs the native C++
+engine, exact result equality.  On the CPU jax backend the single case
+takes ~30 s and the dual case several minutes.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.native import native_consensus, native_dual_consensus
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+
+@pytest.mark.slow
+def test_north_star_single_parity():
+    """256 reads x 10 kb at 1% error — the headline bench config."""
+    num_reads, seq_len, er = 256, 10_000, 0.01
+    truth, reads = generate_test(4, seq_len, num_reads, er, seed=0)
+    band = 16 + int(2 * er * seq_len)
+    cfg = lambda b: (  # noqa: E731
+        CdwfaConfigBuilder()
+        .min_count(num_reads // 4)
+        .backend(b)
+        .initial_band(band)
+        .build()
+    )
+    cpu = native_consensus(reads, config=cfg("native"))
+    engine = ConsensusDWFA(cfg("jax"))
+    for r in reads:
+        engine.add_sequence(r)
+    got = engine.consensus()
+    assert [(c.sequence, c.scores) for c in got] == cpu
+    assert got[0].sequence == truth
+    counters = engine.last_search_stats["scorer_counters"]
+    assert counters["grow_e_events"] == 0  # the band seed must hold
+
+
+@pytest.mark.slow
+def test_dual_scale_parity():
+    """64 reads x 5 kb, two haplotypes differing by 3 SNPs."""
+    num_reads, seq_len, er = 64, 5000, 0.01
+    rng = np.random.default_rng(1)
+    truth, reads1 = generate_test(4, seq_len, num_reads // 2, er, seed=1)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=3, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    h2 = bytes(h2)
+    reads = list(reads1) + [
+        corrupt(h2, er, np.random.default_rng(100 + i))
+        for i in range(num_reads // 2)
+    ]
+    band = 16 + int(2 * er * seq_len)
+    cfg = lambda b: (  # noqa: E731
+        CdwfaConfigBuilder()
+        .min_count(num_reads // 4)
+        .backend(b)
+        .initial_band(band)
+        .build()
+    )
+    cpu = native_dual_consensus(reads, config=cfg("native"))
+    engine = DualConsensusDWFA(cfg("jax"))
+    for r in reads:
+        engine.add_sequence(r)
+    got = engine.consensus()
+    assert got == cpu
+    assert got[0].is_dual()

@@ -254,11 +254,19 @@ class DualConsensusDWFA:
         results = engine.consensus()
     """
 
-    def __init__(self, config: Optional[CdwfaConfig] = None) -> None:
+    def __init__(
+        self,
+        config: Optional[CdwfaConfig] = None,
+        scorer: Optional[WavefrontScorer] = None,
+    ) -> None:
         self.config = config if config is not None else CdwfaConfig()
         self.sequences: List[bytes] = []
         self.offsets: List[Optional[int]] = []
         self.alphabet: set = set()
+        #: optional injected scorer (e.g. a SubsetScorer view of a scorer
+        #: shared across priority-engine worklist groups); its reads must
+        #: match the added sequences exactly
+        self._injected_scorer = scorer
 
     @classmethod
     def with_config(cls, config: CdwfaConfig) -> "DualConsensusDWFA":
@@ -315,7 +323,17 @@ class DualConsensusDWFA:
                 "Must have at least one initial offset of None to see the consensus."
             )
 
-        scorer = make_scorer(self.sequences, cfg)
+        if self._injected_scorer is not None:
+            scorer = self._injected_scorer
+            check_invariant(
+                scorer.reads == self.sequences,
+                "injected scorer reads match added sequences",
+            )
+        else:
+            scorer = make_scorer(self.sequences, cfg)
+        # shared (injected) scorers carry cumulative counters across
+        # groups; report this search's delta, not the running total
+        counters_before = dict(getattr(scorer, "counters", {}))
         initial_size = max(len(s) for s in self.sequences)
         single_tracker = PQueueTracker(initial_size, cfg.max_capacity_per_size)
         dual_tracker = PQueueTracker(initial_size, cfg.max_capacity_per_size)
@@ -638,6 +656,16 @@ class DualConsensusDWFA:
 
         logger.debug("nodes_explored: %d", nodes_explored)
         logger.debug("nodes_ignored: %d", nodes_ignored)
+        #: search-shape observability for bench.py / profiling
+        counters_after = dict(getattr(scorer, "counters", {}))
+        self.last_search_stats = {
+            "nodes_explored": nodes_explored,
+            "nodes_ignored": nodes_ignored,
+            "scorer_counters": {
+                k: v - counters_before.get(k, 0)
+                for k, v in counters_after.items()
+            },
+        }
         return results
 
     # ==================================================================

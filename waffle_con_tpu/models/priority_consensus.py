@@ -21,6 +21,7 @@ from waffle_con_tpu.models.consensus import (
     check_invariant,
 )
 from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+from waffle_con_tpu.ops.scorer import SubsetScorer, make_scorer
 
 logger = logging.getLogger(__name__)
 
@@ -124,12 +125,38 @@ class PriorityConsensusDWFA:
 
         consensuses: List[List[Consensus]] = []
         assignments: List[List[bool]] = []
+        # one device scorer per chain level, shared across every worklist
+        # group at that level: the reference re-creates the whole engine
+        # per group (src/priority_consensus.rs:201-211), which on a device
+        # backend would re-upload the reads and re-compile every kernel
+        # for each group's geometry.  A SubsetScorer view gives each group
+        # identical semantics over the shared state (a group is just the
+        # root activation mask), so only ONE scorer is constructed per
+        # level per consensus() call.
+        level_scorers: dict = {}
+        merged_counters: dict = {}
+        scorer_constructions = 0
+        share_scorer = self.config.backend == "jax"
         while to_split:
             include_set = to_split.pop()
             current_split_level = split_levels.pop()
             current_chain = consensus_chains.pop()
 
-            dc_dwfa = DualConsensusDWFA(self.config)
+            injected = None
+            if share_scorer:
+                base = level_scorers.get(current_split_level)
+                if base is None:
+                    base = make_scorer(
+                        [chain[current_split_level] for chain in self.sequences],
+                        self.config,
+                    )
+                    level_scorers[current_split_level] = base
+                    scorer_constructions += 1
+                indices = [i for i, inc in enumerate(include_set) if inc]
+                injected = SubsetScorer(base, indices)
+            else:
+                scorer_constructions += 1  # the dual engine builds its own
+            dc_dwfa = DualConsensusDWFA(self.config, scorer=injected)
             logger.debug(
                 "Calling Dual at level %d with: %s", current_split_level, include_set
             )
@@ -143,6 +170,8 @@ class PriorityConsensusDWFA:
                     )
 
             dc_result = dc_dwfa.consensus()
+            for k, v in dc_dwfa.last_search_stats["scorer_counters"].items():
+                merged_counters[k] = merged_counters.get(k, 0) + v
             if len(dc_result) > 1:
                 logger.debug(
                     "Multiple dual consensuses detected, arbitrarily selecting "
@@ -182,6 +211,21 @@ class PriorityConsensusDWFA:
                     to_split.append(include_set)
                     split_levels.append(new_split_level)
                     consensus_chains.append(current_chain)
+
+            # evict shared scorers no pending group can reach (levels only
+            # ever increase per group), releasing their device state
+            if share_scorer and level_scorers:
+                alive = set(split_levels)
+                for lvl in [l for l in level_scorers if l not in alive]:
+                    del level_scorers[lvl]
+
+        #: aggregated per-group scorer-counter deltas (bench.py /
+        #: profiling observability); scorer_constructions is the
+        #: per-consensus() ctor count the sharing exists to minimize
+        self.last_search_stats = {
+            "scorer_counters": merged_counters,
+            "scorer_constructions": scorer_constructions,
+        }
 
         if len(consensuses) > 1:
             indices = [-1] * len(self.sequences)

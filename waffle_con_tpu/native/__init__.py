@@ -220,6 +220,8 @@ def native_wfa_ed(
 
 _ENGINE_ERRORS = {
     1: "Must have at least one initial offset of None to see the consensus.",
+    2: "Encountered coverage gap",  # detail-less fallback; the engine
+    # normally attaches [top_len, max_activate] for the full message
     3: "Finalize called on DWFA that was never initialized.",
     4: "internal invariant violated: activating an already-active read",
 }
@@ -280,7 +282,11 @@ def _check_offsets(offsets, n: int, what: str = "offsets"):
 
 
 def _call_blob(fn, *args):
-    """Invoke a blob-returning engine entry; raises EngineError on rc != 0."""
+    """Invoke a blob-returning engine entry; raises EngineError on rc != 0.
+
+    Error rc 2 (coverage gap) carries a 2x i64 detail blob so the raised
+    message matches the reference exactly, lengths included
+    (``/root/reference/src/consensus.rs:305``)."""
     from waffle_con_tpu.models.consensus import EngineError
 
     lib = load_library()
@@ -288,6 +294,16 @@ def _call_blob(fn, *args):
     size = _I64(0)
     rc = fn(lib, *args, ctypes.byref(blob), ctypes.byref(size))
     if rc != 0:
+        detail = b""
+        if blob and size.value > 0:
+            detail = ctypes.string_at(blob, size.value)
+            lib.wn_blob_free(blob)
+        if rc == 2 and len(detail) == 16:
+            top_len, max_activate = struct.unpack("<qq", detail)
+            raise EngineError(
+                f"Encountered coverage gap: consensus is length {top_len} "
+                f"with no candidates, but sequences activate at {max_activate}"
+            )
         raise EngineError(_ENGINE_ERRORS.get(rc, f"native engine error {rc}"))
     try:
         return ctypes.string_at(blob, size.value)
@@ -418,8 +434,6 @@ def native_consensus(
 ) -> List[Tuple[bytes, List[int]]]:
     """Run the full C++ single-consensus engine; returns
     ``[(sequence, scores), ...]`` sorted lexicographically."""
-    from waffle_con_tpu.models.consensus import EngineError
-
     cfg = config if config is not None else CdwfaConfig()
     if offsets is None:
         offsets = [None] * len(reads)
@@ -429,16 +443,11 @@ def native_consensus(
         [-1 if o is None else o for o in offsets], dtype=np.int64
     )
     int_cfg = np.array(_int_cfg_base(cfg), dtype=np.int64)
-    try:
-        raw = _call_blob(
-            lambda lib, *a: lib.wn_consensus(*a),
-            data_ptr, lens_ptr, len(reads), offs.ctypes.data_as(_I64P),
-            int_cfg.ctypes.data_as(_I64P), cfg.min_af,
-        )
-    except EngineError as exc:
-        if "native engine error 2" in str(exc):
-            raise EngineError("Encountered coverage gap") from None
-        raise
+    raw = _call_blob(
+        lambda lib, *a: lib.wn_consensus(*a),
+        data_ptr, lens_ptr, len(reads), offs.ctypes.data_as(_I64P),
+        int_cfg.ctypes.data_as(_I64P), cfg.min_af,
+    )
 
     reader = _BlobReader(raw)
     results = []

@@ -311,7 +311,11 @@ size_t build_activate_points(const std::vector<i64>& offsets,
 
 int run_consensus(const std::vector<Bytes>& reads,
                   const std::vector<i64>& in_offsets,  // -1 = none
-                  const EngineConfig& cfg, std::vector<Result>& out) {
+                  const EngineConfig& cfg, std::vector<Result>& out,
+                  i64* gap_info = nullptr) {  // [top_len, max_activate] on
+                                              // ERR_COVERAGE_GAP (the
+                                              // reference message carries
+                                              // both, consensus.rs:305)
   const size_t R = reads.size();
   const bool l2 = cfg.cost_l2 != 0;
   const bool et = cfg.allow_early_termination != 0;
@@ -423,7 +427,13 @@ int run_consensus(const std::vector<Bytes>& reads,
       if (c >= threshold) passing.push_back(sym);
 
     if (passing.empty()) {
-      if (top_len < max_activate) return ERR_COVERAGE_GAP;
+      if (top_len < max_activate) {
+        if (gap_info) {
+          gap_info[0] = top_len;
+          gap_info[1] = max_activate;
+        }
+        return ERR_COVERAGE_GAP;
+      }
       continue;
     }
 
@@ -1258,8 +1268,19 @@ int wn_consensus(const uint8_t* read_data, const i64* read_lens, i64 n_reads,
 
   std::vector<i64> offs(offsets, offsets + n_reads);
   std::vector<Result> results;
-  int rc = run_consensus(reads, offs, cfg, results);
-  if (rc != ERR_OK) return rc;
+  i64 gap[2] = {0, 0};
+  int rc = run_consensus(reads, offs, cfg, results, gap);
+  if (rc != ERR_OK) {
+    if (rc == ERR_COVERAGE_GAP && out_blob != nullptr) {
+      // error-detail blob: the two i64s the reference interpolates into
+      // its coverage-gap message (consensus.rs:305)
+      uint8_t* detail = (uint8_t*)malloc(2 * sizeof(i64));
+      std::memcpy(detail, gap, 2 * sizeof(i64));
+      *out_blob = detail;
+      *out_size = 2 * sizeof(i64);
+    }
+    return rc;
+  }
 
   i64 size = sizeof(i64);
   for (auto& r : results)

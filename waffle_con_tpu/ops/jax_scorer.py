@@ -625,27 +625,19 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
             > 2048
         )
 
-        # per-read vote weights from relative edit distances (reference
-        # get_ed_weights, dual_consensus.rs:1299-1336)
+        # per-read vote weights: ed-scaled when weighted_by_ed (reference
+        # get_ed_weights, dual_consensus.rs:1299-1336), otherwise FULL
+        # weight for every read tracked on that side — the reference's
+        # unweighted nomination uses vec![1.0; n], NOT the 1.0/0.5/0.0
+        # comparison lattice (dual_consensus.rs:1257-1262)
         both = acta & actb
         c1f = jnp.maximum(edsa.astype(jnp.float32), 0.5)
         c2f = jnp.maximum(edsb.astype(jnp.float32), 0.5)
         denom = c1f + c2f
         wa_soft = jnp.where(both, c2f / denom, jnp.where(acta, 1.0, 0.0))
         wb_soft = jnp.where(both, c1f / denom, jnp.where(actb, 1.0, 0.0))
-        eq = both & (c1f == c2f)
-        wa_hard = jnp.where(
-            both,
-            jnp.where(eq, 0.5, jnp.where(c1f < c2f, 1.0, 0.0)),
-            jnp.where(acta, 1.0, 0.0),
-        )
-        wb_hard = jnp.where(
-            both,
-            jnp.where(eq, 0.5, jnp.where(c2f < c1f, 1.0, 0.0)),
-            jnp.where(actb, 1.0, 0.0),
-        )
-        wa = jnp.where(weighted, wa_soft, wa_hard)
-        wb = jnp.where(weighted, wb_soft, wb_hard)
+        wa = jnp.where(weighted, wa_soft, jnp.where(acta, 1.0, 0.0))
+        wb = jnp.where(weighted, wb_soft, jnp.where(actb, 1.0, 0.0))
 
         def side(occ, split, w):
             counts, has_votes, n_cands, exactable = _dual_votes(
@@ -1019,6 +1011,11 @@ class JaxScorer(WavefrontScorer):
         n = len(specs)
         npad = _next_pow2(n)
         slots = [self._slot_of[h] for h, _ in specs]
+        if len(set(slots)) != n:
+            # duplicate slots in one scatter batch would make the committed
+            # row depend on scatter ordering; the engines never do this
+            # (children are distinct clones), so treat it as a caller bug
+            raise ValueError("push_many: duplicate branch handles in batch")
         syms = [self.sym_id[consensus[-1]] for _, consensus in specs]
         slots += [slots[0]] * (npad - n)
         syms += [syms[0]] * (npad - n)

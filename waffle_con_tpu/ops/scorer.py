@@ -255,6 +255,120 @@ class PythonScorer(WavefrontScorer):
         return BranchStats(eds, occ, split, reached)
 
 
+class SubsetScorer(WavefrontScorer):
+    """View of a shared base scorer restricted to a subset of its reads.
+
+    The priority engine re-runs the dual engine once per worklist group
+    over subsets of the same level's sequences
+    (``/root/reference/src/priority_consensus.rs:172-341`` re-creates the
+    whole engine per group).  Building a fresh device scorer per group
+    would re-upload the reads and re-compile every kernel for the group's
+    geometry; this adapter instead maps a group onto a scorer built ONCE
+    over the full read set — group membership is just the root
+    activation mask, and per-read observations are gathered back to the
+    group's local index space with numpy fancy indexing.
+
+    Device-state semantics are unchanged: untracked (non-member) reads
+    are inactive lanes, exactly as pruned reads already are, so results
+    are bit-identical to a per-group scorer.
+    """
+
+    def __init__(self, base: WavefrontScorer, indices: Sequence[int]) -> None:
+        self.base = base
+        self.indices = np.asarray(list(indices), dtype=np.int64)
+        self.reads = [base.reads[i] for i in self.indices]
+        self.config = base.config
+        self.symtab = base.symtab
+        self.sym_id = base.sym_id
+        # engines feature-test these with getattr(..., None); shadow the
+        # forwarding methods when the base lacks the device fast path
+        if not hasattr(base, "run_extend"):
+            self.run_extend = None  # type: ignore[assignment]
+        if not hasattr(base, "run_extend_dual"):
+            self.run_extend_dual = None  # type: ignore[assignment]
+
+    @property
+    def counters(self):
+        return getattr(self.base, "counters", {})
+
+    def _slice(self, stats: BranchStats) -> BranchStats:
+        idx = self.indices
+        return BranchStats(
+            stats.eds[idx],
+            stats.occ[idx],
+            stats.split[idx],
+            stats.reached[idx],
+        )
+
+    # -- branch lifecycle ----------------------------------------------
+    def root(self, active: np.ndarray) -> int:
+        full = np.zeros(self.base.num_reads, dtype=bool)
+        full[self.indices] = np.asarray(active, dtype=bool)
+        return self.base.root(full)
+
+    def clone(self, h: int) -> int:
+        return self.base.clone(h)
+
+    def clone_many(self, hs: List[int]) -> List[int]:
+        return self.base.clone_many(hs)
+
+    def free(self, h: int) -> None:
+        self.base.free(h)
+
+    # -- state evolution -----------------------------------------------
+    def push(self, h: int, consensus: bytes) -> BranchStats:
+        return self._slice(self.base.push(h, consensus))
+
+    def push_many(
+        self, specs: List[Tuple[int, bytes]]
+    ) -> List[BranchStats]:
+        return [self._slice(s) for s in self.base.push_many(specs)]
+
+    def stats(self, h: int, consensus: bytes) -> BranchStats:
+        return self._slice(self.base.stats(h, consensus))
+
+    def activate(
+        self, h: int, read_index: int, offset: int, consensus: bytes
+    ) -> None:
+        self.base.activate(
+            h, int(self.indices[read_index]), offset, consensus
+        )
+
+    def deactivate(self, h: int, read_index: int) -> None:
+        self.base.deactivate(h, int(self.indices[read_index]))
+
+    def deactivate_many(self, pairs: List[Tuple[int, int]]) -> None:
+        self.base.deactivate_many(
+            [(h, int(self.indices[r])) for h, r in pairs]
+        )
+
+    def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
+        return self.base.finalized_eds(h, consensus)[self.indices]
+
+    # -- device fast paths (shadowed with None when the base lacks them)
+    def run_extend(self, h, consensus, *args, **kwargs):
+        steps, code, appended, stats = self.base.run_extend(
+            h, consensus, *args, **kwargs
+        )
+        return steps, code, appended, self._slice(stats)
+
+    def run_extend_dual(self, h1, h2, consensus1, consensus2, *args, **kwargs):
+        (steps, code, app1, app2, stats1, stats2, act1, act2) = (
+            self.base.run_extend_dual(h1, h2, consensus1, consensus2, *args, **kwargs)
+        )
+        idx = self.indices
+        return (
+            steps,
+            code,
+            app1,
+            app2,
+            self._slice(stats1),
+            self._slice(stats2),
+            act1[idx],
+            act2[idx],
+        )
+
+
 def make_scorer(reads: Sequence[bytes], config: CdwfaConfig) -> WavefrontScorer:
     """Instantiate the scorer selected by ``config.backend``."""
     if config.backend == "python":

@@ -78,7 +78,11 @@ def shard_scorer(scorer, mesh: Mesh, read_axis: str = "read") -> None:
     collectives.  Donated updates preserve the placement, so the state
     stays sharded for the scorer's lifetime.
     """
-    n = mesh.devices.size if read_axis not in mesh.shape else mesh.shape[read_axis]
+    if read_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has no axis {read_axis!r} (axes: {tuple(mesh.shape)})"
+        )
+    n = mesh.shape[read_axis]
     if scorer._R % n != 0:
         raise ValueError(
             f"padded read count {scorer._R} not divisible by mesh axis {n}"
