@@ -156,21 +156,20 @@ def candidates_from_stats(
     (parity with ``/root/reference/src/consensus.rs:540-564``).
     """
     votes: Dict[int, float] = {}
-    occ = stats.occ
-    split = stats.split
-    n, a = occ.shape
-    for r in range(n):
-        total = split[r]
+    # plain-Python ints/floats: identical IEEE-double arithmetic to the
+    # numpy scalar path, without per-element numpy boxing overhead
+    occ = stats.occ.tolist()
+    split = stats.split.tolist()
+    syms = symtab.tolist()
+    for r, total in enumerate(split):
         if total == 0:
             continue
         w = 1.0 if weights is None else weights[r]
         if w <= 0.0:
             continue
-        row = occ[r]
-        for s in range(a):
-            c = row[s]
+        for s, c in enumerate(occ[r]):
             if c:
-                sym = int(symtab[s])
+                sym = syms[s]
                 add = c / total if weights is None else w * c / total
                 votes[sym] = votes.get(sym, 0.0) + add
     if wildcard is not None and len(votes) > 1:

@@ -1123,6 +1123,8 @@ class JaxScorer(WavefrontScorer):
         code = int(code)
         self.counters["run_calls"] += 1
         self.counters["run_steps"] += steps
+        key = f"run_stop_{code}"
+        self.counters[key] = self.counters.get(key, 0) + 1
         appended = b""
         if steps:
             ids = cons_np[len(consensus) : len(consensus) + steps]
@@ -1188,6 +1190,8 @@ class JaxScorer(WavefrontScorer):
         code = int(code)
         self.counters["run_dual_calls"] += 1
         self.counters["run_dual_steps"] += steps
+        key = f"run_dual_stop_{code}"
+        self.counters[key] = self.counters.get(key, 0) + 1
 
         def appended(cons_np, consensus):
             if not steps:
