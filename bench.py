@@ -291,6 +291,8 @@ def bench_single(num_reads, seq_len, error_rate, trace=None):
             "run_extend_calls": counters.get("run_calls", 0),
             "run_extend_steps": counters.get("run_steps", 0),
             "push_calls": counters.get("push_calls", 0),
+            "arena_calls": counters.get("arena_calls", 0),
+            "arena_steps": counters.get("arena_steps", 0),
             "grow_events": counters.get("grow_e_events", 0),
             "replayed_cols": counters.get("replayed_cols", 0),
             "initial_band": band,
@@ -367,10 +369,17 @@ def bench_dual(num_reads, seq_len, error_rate):
             "run_dual_steps": counters.get("run_dual_steps", 0),
             "run_calls": counters.get("run_calls", 0),
             "run_steps": counters.get("run_steps", 0),
+            "arena_calls": counters.get("arena_calls", 0),
+            "arena_steps": counters.get("arena_steps", 0),
             "push_calls": counters.get("push_calls", 0),
             "grow_events": counters.get("grow_e_events", 0),
             "dual_engagement": round(
-                counters.get("run_dual_steps", 0) / total_symbols, 3
+                (
+                    counters.get("run_dual_steps", 0)
+                    + counters.get("arena_dual_steps", 0)
+                )
+                / total_symbols,
+                3,
             ),
         },
     }

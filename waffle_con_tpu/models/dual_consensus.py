@@ -85,6 +85,24 @@ class DualConsensus:
         )
 
 
+def _extend_active_tables(
+    cfg, activate_points, total_active_count, active_min_count, length
+) -> None:
+    """Grow the per-length active-read-count / dynamic-min-count tables by
+    one entry when ``length`` is their current frontier.  The ONE copy of
+    this arithmetic: the pop loop, the run-replay path, and the arena
+    replay must stay bit-identical for the fast paths to match the
+    per-symbol flow."""
+    if len(active_min_count) == length + 1:
+        new_total = total_active_count[length] + len(
+            activate_points.get(length, [])
+        )
+        total_active_count.append(new_total)
+        active_min_count.append(
+            max(cfg.min_count, math.ceil(cfg.min_af * new_total))
+        )
+
+
 class _DualNode:
     """Search node holding one (non-dual) or two consensus branches."""
 
@@ -437,6 +455,25 @@ class DualConsensusDWFA:
                     )
                 else:
                     runnable = len(specs_now) == 1 and specs_now[0][0] == "single"
+            # -- arena fast path: when the best OTHER queue entry is an
+            # arena-compatible node, resolve the A<->B pop competition on
+            # device (>99% of plain-run stops are "would lose the next
+            # pop"); falls back to the single-node run below when not
+            # engaged.  Commits update both nodes + exact tracker replay.
+            if runnable and getattr(scorer, "run_arena", None) is not None:
+                arena = self._arena_attempt(
+                    scorer, pqueue, node, top_cost, maximum_error,
+                    activate_points, cost, single_tracker, dual_tracker,
+                    farthest_single, farthest_dual,
+                    single_last_constraint, dual_last_constraint,
+                    total_active_count, active_min_count,
+                )
+                if arena is not None:
+                    (farthest_single, farthest_dual,
+                     single_last_constraint, dual_last_constraint,
+                     arena_steps) = arena
+                    nodes_explored += arena_steps
+                    continue
             if runnable:
                 best_other = pqueue.peek_priority()
                 other_cost = 2**31 - 1
@@ -512,17 +549,13 @@ class DualConsensusDWFA:
                             self._drop_prefetch(scorer, node)
 
                             def extend_tables(length):
-                                if len(active_min_count) == length + 1:
-                                    new_total = total_active_count[length] + len(
-                                        activate_points.get(length, [])
-                                    )
-                                    total_active_count.append(new_total)
-                                    active_min_count.append(
-                                        max(
-                                            cfg.min_count,
-                                            math.ceil(cfg.min_af * new_total),
-                                        )
-                                    )
+                                _extend_active_tables(
+                                    cfg,
+                                    activate_points,
+                                    total_active_count,
+                                    active_min_count,
+                                    length,
+                                )
 
                             kind_constraint = (
                                 dual_last_constraint
@@ -601,14 +634,10 @@ class DualConsensusDWFA:
                     logger.debug("Finalized node is imbalanced, ignoring.")
 
             # -- maintain the dynamic active-count tables -------------
-            if len(active_min_count) == top_len + 1:
-                new_total = total_active_count[top_len] + len(
-                    activate_points.get(top_len, [])
-                )
-                total_active_count.append(new_total)
-                active_min_count.append(
-                    max(cfg.min_count, math.ceil(cfg.min_af * new_total))
-                )
+            _extend_active_tables(
+                cfg, activate_points, total_active_count, active_min_count,
+                top_len,
+            )
 
             # -- extension ---------------------------------------------
             self._expand(
@@ -667,6 +696,207 @@ class DualConsensusDWFA:
             },
         }
         return results
+
+    # ==================================================================
+    # arena fast path
+
+    def _arena_attempt(
+        self, scorer, pqueue, node, top_cost, maximum_error,
+        activate_points, cost, single_tracker, dual_tracker,
+        farthest_single, farthest_dual,
+        single_last_constraint, dual_last_constraint,
+        total_active_count, active_min_count,
+    ):
+        """Engage the device pop arena for the in-hand node plus up to
+        ``ARENA_K - 1`` of the next-best queue entries.  Returns ``None``
+        when not engaged (competitors incompatible / zero steps committed
+        — every popped competitor is restored with its ORIGINAL insertion
+        order), else commits the nodes' extensions, replays the exact
+        per-pop tracker bookkeeping, and returns the updated
+        ``(farthest_single, farthest_dual, single_last_constraint,
+        dual_last_constraint, steps)``."""
+        cfg = self.config
+        if pqueue.is_empty():
+            return None  # no competitor: the plain run path is strictly better
+
+        # collect the next-best compatible competitors, in pop order; the
+        # first ineligible entry becomes the arena's rest-of-queue bound
+        taken = []
+        while len(taken) < scorer.ARENA_K - 1 and not pqueue.is_empty():
+            cand, pri, seq = pqueue.pop_with_seq()
+            if cand.is_dual and (cand.lock1 or cand.lock2):
+                pqueue.push_restored(cand.key(), cand, pri, seq)
+                break
+            taken.append((cand, pri, seq))
+        if not taken:
+            return None
+
+        def restore_all():
+            for cand, pri, seq in taken:
+                pqueue.push_restored(cand.key(), cand, pri, seq)
+
+        nodes = [node] + [t[0] for t in taken]
+        step_limit = scorer.ARENA_CAP
+        for nd in nodes:
+            nl = nd.max_consensus_length()
+            next_act = min((l for l in activate_points if l > nl), default=None)
+            if next_act is not None:
+                step_limit = min(step_limit, next_act - nl - 1)
+        step_limit = min(
+            step_limit,
+            cfg.max_nodes_wo_constraint - single_last_constraint - 1,
+            cfg.max_nodes_wo_constraint - dual_last_constraint - 1,
+        )
+        if step_limit < 1:
+            restore_all()
+            return None
+
+        rest = pqueue.peek_priority()
+        rest_cost = 2**31 - 1
+        rest_len = 0
+        if rest is not None:
+            rest_cost = -rest[0]
+            rest_len = rest[1]
+
+        needed = (
+            max(
+                max(nd.max_consensus_length() for nd in nodes),
+                farthest_single,
+                farthest_dual,
+            )
+            + scorer.ARENA_CAP
+            + 4
+        )
+        win_len = 1 << (needed - 1).bit_length()
+        lc_s, pc_s = single_tracker.export_windows(win_len)
+        lc_d, pc_d = dual_tracker.export_windows(win_len)
+        tr_scalars = [
+            [
+                single_tracker.threshold(), len(single_tracker),
+                farthest_single, single_last_constraint,
+            ],
+            [
+                dual_tracker.threshold(), len(dual_tracker),
+                farthest_dual, dual_last_constraint,
+            ],
+        ]
+        me_budget = (
+            int(maximum_error) if maximum_error != math.inf else 2**31 - 1
+        )
+        (hist, nsteps, _code, _stop_node, node_steps, appended,
+         sides_stats, sides_act) = scorer.run_arena(
+            [
+                (
+                    nd.h1,
+                    nd.h2 if nd.is_dual else None,
+                    len(nd.consensus1),
+                    len(nd.consensus2),
+                )
+                for nd in nodes
+            ],
+            me_budget,
+            cfg.min_count,
+            cfg.dual_max_ed_delta,
+            cfg.min_count,  # imb_min: static under the min_af == 0 gate
+            cost is ConsensusCost.L2_DISTANCE,
+            cfg.weighted_by_ed,
+            rest_cost,
+            rest_len,
+            cfg.max_queue_size,
+            cfg.max_capacity_per_size,
+            step_limit,
+            np.stack([lc_s, lc_d]),
+            np.stack([pc_s, pc_d]),
+            np.asarray(tr_scalars, dtype=np.int32),
+        )
+        if nsteps == 0:
+            restore_all()
+            return None
+
+        for i, nd in enumerate(nodes):
+            if node_steps[i] > 0:
+                self._drop_prefetch(scorer, nd)
+
+        # exact tracker replay of the committed interleaved pop sequence
+        # (mirrors the engine's per-pop order: constrict both kinds,
+        # remove, process, insert; the in-hand first pop was already
+        # constricted and removed before the arena engaged)
+        kinds = [1 if nd.is_dual else 0 for nd in nodes]
+        lens = [nd.max_consensus_length() for nd in nodes]
+        far = [farthest_single, farthest_dual]
+        lcon = [single_last_constraint, dual_last_constraint]
+        trackers = (single_tracker, dual_tracker)
+        for i, which in enumerate(hist):
+            which = int(which)
+            k = kinds[which]
+            length = lens[which]
+            if i > 0:
+                for kk in (0, 1):
+                    while (
+                        len(trackers[kk]) > cfg.max_queue_size
+                        or lcon[kk] >= cfg.max_nodes_wo_constraint
+                    ) and trackers[kk].threshold() < far[kk]:
+                        trackers[kk].increment_threshold()
+                        lcon[kk] = 0
+                trackers[k].remove(length)
+            far[k] = max(far[k], length)
+            lcon[k] += 1
+            trackers[k].process(length)
+            trackers[k].insert(length + 1)
+            _extend_active_tables(
+                cfg, activate_points, total_active_count, active_min_count,
+                length,
+            )
+            lens[which] += 1
+        # kind-split step attribution for the engagement metrics
+        arena_dual = sum(1 for w in hist if kinds[int(w)] == 1)
+        scorer.counters["arena_dual_steps"] = (
+            scorer.counters.get("arena_dual_steps", 0) + arena_dual
+        )
+        scorer.counters["arena_single_steps"] = (
+            scorer.counters.get("arena_single_steps", 0)
+            + (int(nsteps) - arena_dual)
+        )
+
+        for i, nd in enumerate(nodes):
+            if node_steps[i] == 0:
+                continue
+            s1, s2 = 2 * i, 2 * i + 1
+            nd.consensus1 = nd.consensus1 + appended[s1]
+            nd.stats1 = sides_stats[s1]
+            if nd.is_dual:
+                nd.consensus2 = nd.consensus2 + appended[s2]
+                nd.stats2 = sides_stats[s2]
+                a1 = sides_act[s1]
+                a2 = sides_act[s2]
+                for r in range(len(nd.active1)):
+                    if nd.active1[r] and not bool(a1[r]):
+                        nd.active1[r] = False
+                        nd.offsets1[r] = None
+                    if nd.active2[r] and not bool(a2[r]):
+                        nd.active2[r] = False
+                        nd.offsets2[r] = None
+
+        # re-queue: extended nodes re-enter in the order of their LAST
+        # arena pop (later pop -> newer insertion seq); never-popped
+        # competitors keep their original seq (FIFO tie order preserved)
+        last_pop = {}
+        for i, which in enumerate(hist):
+            last_pop[int(which)] = i
+        for i, (cand, pri, seq) in enumerate(taken, start=1):
+            if node_steps[i] == 0:
+                ok = pqueue.push_restored(cand.key(), cand, pri, seq)
+                check_invariant(ok, "arena restore unique")
+        for idx in sorted(last_pop, key=last_pop.get):
+            nd = nodes[idx]
+            if not pqueue.push(nd.key(), nd, nd.priority(cost)):
+                # two nodes converged to one key: handled like every other
+                # insertion path (_queue_child) — drop the newcomer and
+                # undo its replayed tracker insert
+                logger.warning("duplicate dual search node (arena re-queue)")
+                trackers[kinds[idx]].remove(nd.max_consensus_length())
+                self._free_node(scorer, nd)
+        return far[0], far[1], lcon[0], lcon[1], int(nsteps)
 
     # ==================================================================
     # node helpers

@@ -459,7 +459,12 @@ def _j_run(state, reads, rlen, params, wc, et, num_symbols):
         dirty = ambiguous | (npass != 1) | (n_cands == 0) | cost_overflow
 
         # early-termination runs freeze a reached read rather than ending
-        # the search, so only stop when the node as a whole may be complete
+        # the search, so only stop when the node as a whole may be
+        # complete.  CONSERVATIVE fold: inactive lanes count as done, so
+        # the run stops at (or before) every host-recordable state — the
+        # kernel cannot tell a padding/non-member lane (must not block)
+        # from a real inactive read (blocks recording host-side); the
+        # host re-checks the real condition at the stop pop.
         reached_stop = jnp.where(et, (reached | ~act).all(), reached.any())
         wins_pop = (total < other_cost) | (
             (total == other_cost) & (clen > other_len)
@@ -664,13 +669,25 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
         # a side counting as finished adds a do-not-extend option to the
         # host's cross product — host arbitration either way
         reached_read = (acta & reacheda) | (actb & reachedb)
+        # per-side "finished" mirrors reached_consensus_end: under early
+        # termination an INACTIVE read counts as finished (require_all
+        # default), unlike the whole-node record condition below
         fin_a = jnp.where(
             et, (reacheda | ~acta).all(), (acta & reacheda).any()
         )
         fin_b = jnp.where(
             et, (reachedb | ~actb).all(), (actb & reachedb).any()
         )
-        reached_stop = jnp.where(et, reached_read.all(), reached_read.any())
+        # CONSERVATIVE completion fold (cf. _j_run): lanes inactive on
+        # BOTH sides count as done so the run stops at or before every
+        # host-recordable state — padding/non-member lanes must not block
+        # and are indistinguishable from real never-activated reads here;
+        # the host re-checks the real condition at the stop pop.
+        # (Previously padding lanes blocked the fold outright, so et dual
+        # runs never saw code 2 and could commit past recordable states.)
+        reached_stop = jnp.where(
+            et, (reached_read | (~acta & ~actb)).all(), reached_read.any()
+        )
         cur_len = jnp.maximum(clena, clenb)
         wins_pop = (total < other_cost) | (
             (total == other_cost) & (cur_len > other_len)
@@ -760,6 +777,413 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
     out["cons"] = state["cons"].at[ha].set(consa).at[hb].set(consb)
     out["clen"] = state["clen"].at[ha].set(clena).at[hb].set(clenb)
     return out, steps, code, stats_a, stats_b, acta, actb, consa, consb
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_symbols", "max_steps", "K"),
+    donate_argnums=(0,),
+)
+def _j_arena(
+    state, reads, rlen, params, slots, kinds, seqv0, tr_scalars, lc, pc,
+    wc, et, num_symbols, max_steps, K,
+):
+    """K-node pop ARENA: resolve the pop competition among the K best
+    runnable queue entries entirely on device.
+
+    Measured motivation: >99% of ``_j_run``/``_j_run_dual`` stops are
+    "would lose the next pop" — a handful of live chains at near-equal
+    cost leapfrog, costing one full host round-trip per few committed
+    symbols each.  The arena simulates the host's EXACT pop loop for the
+    group: priority comparison (cost, then length, then insertion
+    order), per-kind tracker bookkeeping (threshold constriction,
+    per-length capacity, queue totals — ``utils/pqueue.py`` semantics),
+    me-budget/threshold/capacity/imbalance discard *detection*, per-node
+    candidate nomination, and committed extensions.  It stops BEFORE any
+    pop the host must arbitrate (ambiguous votes, reached end, any
+    discard condition, a rest-of-queue entry winning, band overflow), so
+    the host never replays a decision — it re-derives it naturally at
+    the next real pop.  The host replays the committed pop history onto
+    the real trackers (``DualConsensusDWFA._arena_attempt``).
+
+    Layout: node n in {0..K-1} owns side rows 2n and 2n+1 of every
+    per-side carry; single-kind and dead nodes back row(s) with DISTINCT
+    scratch slots (content is garbage and overwritten — repeated slots
+    would make the final scatter write conflicting rows).  Node 0 is the
+    engine's in-hand pop (its first pop is forced and skips
+    constriction/remove, which the engine already performed).  ``kinds``
+    is ``[K] int32`` (0 single, 1 dual, -1 dead/pad) and selects each
+    node's tracker in ``tr_scalars``/``lc``/``pc`` (stacked ``[2, ...]``:
+    row 0 single tracker, row 1 dual).  ``seqv0`` ranks the nodes'
+    original queue insertion order for FIFO tie-breaks; re-pushed nodes
+    take fresh, larger ranks and lose full ties to never-popped entries.
+
+    ``params`` is ``[12] int32``: (me_budget, min_count, ed_delta,
+    imb_min, l2, weighted, rest_cost, rest_len, n_live, max_queue_size,
+    capacity_per_size, step_limit).  ``tr_scalars`` is ``[2, 4] int32``:
+    per kind (threshold, total, farthest, last_constraint).  The
+    ``max_nodes_wo_constraint`` constriction trigger cannot fire on
+    device: the host bounds ``step_limit`` below both kinds' remaining
+    budgets.
+
+    Stop codes: 1 = winner needs host arbitration (votes/finished side),
+    2 = winner reached its baseline end (host records the result),
+    3 = a rest-of-queue entry wins the pop, 4 = step limit, 5 = band
+    overflow, 7 = winner would be DISCARDED at its pop (me-budget,
+    threshold, capacity, or dual imbalance) — the host pop performs the
+    discard.  Returns (state, hist, n_steps, code, stop_node,
+    per-node steps, per-side stats, act, cons, clen).
+    """
+    me_budget = params[0]
+    min_count = params[1]
+    delta = params[2]
+    imb_min = params[3]
+    l2 = params[4].astype(bool)
+    weighted = params[5].astype(bool)
+    rest_cost = params[6]
+    rest_len = params[7]
+    n_live = params[8]
+    max_queue = params[9]
+    cap = params[10]
+    step_limit = params[11]
+
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    C = state["cons"].shape[1]
+    Lw = lc.shape[1]
+    R = reads.shape[0]
+
+    offs = state["off"][slots]       # [2K, R]
+    live = jnp.arange(K) < n_live    # [K]
+    is_dual = kinds == 1             # [K]
+    min_count_f = min_count.astype(jnp.float32)
+    EPS = VOTE_EPS
+    BIGTOT = jnp.int32(2**31 - 1)
+
+    def nominate(occ, split, w):
+        """Vote fold + decision for one side; returns (dirty, sym)."""
+        counts, has_votes, n_cands, exactable = _dual_votes(
+            occ, split, w, wc, weighted
+        )
+        maxc = jnp.where(has_votes, counts, -1.0).max()
+        thr = jnp.minimum(min_count_f, maxc)
+        passing = has_votes & (counts >= thr)
+        npass = passing.sum()
+        near_tie = (
+            (jnp.abs(maxc - min_count_f) < EPS)
+            | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
+        )
+        ambiguous = ~exactable & near_tie
+        dirty = ambiguous | (npass != 1) | (n_cands == 0)
+        sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(jnp.int32)
+        return dirty, sym
+
+    def node_eval(dual, off2, act2, eds2, occ2, split2, reached2, clen2):
+        """Per-node decision inputs; side axes are [2, ...]."""
+        a1 = act2[0]
+        a2 = jnp.where(dual, act2[1], False)
+        c1 = jnp.where(l2, eds2[0] * eds2[0], eds2[0])
+        c2 = jnp.where(l2, eds2[1] * eds2[1], eds2[1])
+        BIG = jnp.int32(1 << 28)
+        best = jnp.minimum(jnp.where(a1, c1, BIG), jnp.where(a2, c2, BIG))
+        total = jnp.where(
+            dual,
+            jnp.where(a1 | a2, best, 0).sum(),
+            jnp.where(a1, c1, 0).sum(),
+        )
+        nlen = jnp.where(dual, jnp.maximum(clen2[0], clen2[1]), clen2[0])
+        cost_ovf = l2 & (
+            jnp.maximum(
+                jnp.where(a1, eds2[0], 0).max(),
+                jnp.where(a2, eds2[1], 0).max(),
+            )
+            > 2048
+        )
+        # conservative completion folds (see _j_run/_j_run_dual): lanes
+        # inactive on every tracked side count as done so the arena stops
+        # at or before each host-recordable state
+        rr = (a1 & reached2[0]) | (a2 & reached2[1])
+        reach_stop = jnp.where(
+            dual,
+            jnp.where(et, (rr | (~a1 & ~a2)).all(), rr.any()),
+            jnp.where(
+                et,
+                (reached2[0] | ~a1).all(),
+                reached2[0].any(),
+            ),
+        )
+        fin1 = jnp.where(
+            et, (reached2[0] | ~a1).all(), (a1 & reached2[0]).any()
+        )
+        fin2 = jnp.where(
+            et, (reached2[1] | ~a2).all(), (a2 & reached2[1]).any()
+        )
+        both = a1 & a2
+        c1f = jnp.maximum(eds2[0].astype(jnp.float32), 0.5)
+        c2f = jnp.maximum(eds2[1].astype(jnp.float32), 0.5)
+        denom = c1f + c2f
+        use_w = weighted & dual
+        w1 = jnp.where(
+            use_w & both, c2f / denom, jnp.where(a1, 1.0, 0.0)
+        )
+        w2 = jnp.where(
+            use_w & both, c1f / denom, jnp.where(a2, 1.0, 0.0)
+        )
+        dirty1, sym1 = nominate(occ2[0], split2[0], w1)
+        dirty2, sym2 = nominate(occ2[1], split2[1], w2)
+        dirty = jnp.where(
+            dual, dirty1 | dirty2 | fin1 | fin2, dirty1
+        ) | cost_ovf
+        imb = dual & ((a1.sum() < imb_min) | (a2.sum() < imb_min))
+        return total, nlen, reach_stop, dirty, sym1, sym2, imb
+
+    def body(carry):
+        (D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
+         nsteps, seqv, fresh, seq_ctr, _code, _stop_node) = carry
+
+        eds, occ, split, reached = jax.vmap(
+            lambda D_, e_, rmin_, er_, off_, act_, clen_: _stats_core(
+                D_, e_, rmin_, er_, off_, act_, rlen, reads, clen_,
+                num_symbols, E,
+            )
+        )(D, e, rmin, er, offs, act, clen)
+
+        totals, lens, reach, dirty, sym1s, sym2s, imb = jax.vmap(node_eval)(
+            is_dual,
+            offs.reshape(K, 2, R),
+            act.reshape(K, 2, R),
+            eds.reshape(K, 2, R),
+            occ.reshape(K, 2, R, -1),
+            split.reshape(K, 2, R),
+            reached.reshape(K, 2, R),
+            clen.reshape(K, 2),
+        )
+        totals = jnp.where(live, totals, BIGTOT)
+
+        # ---- pop-winner tournament: host priority is (-cost, len) with
+        # FIFO (smaller seq rank) on full ties
+        def better(i, j):
+            wi = (
+                (totals[i] < totals[j])
+                | ((totals[i] == totals[j]) & (lens[i] > lens[j]))
+                | (
+                    (totals[i] == totals[j])
+                    & (lens[i] == lens[j])
+                    & (seqv[i] < seqv[j])
+                )
+            )
+            return jnp.where(wi, i, j)
+
+        win = jnp.int32(0)
+        for j in range(1, K):
+            win = better(win, jnp.int32(j))
+        first = nsteps == 0
+        win = jnp.where(first, 0, win)
+        wtot = totals[win]
+        wlen = lens[win]
+        # vs the best rest-of-queue entry: rest wins cost ties at equal
+        # length unless the winner's ORIGINAL queue entry (never
+        # re-pushed) predates it
+        rest_wins = ~first & (
+            (wtot > rest_cost)
+            | ((wtot == rest_cost) & (wlen < rest_len))
+            | ((wtot == rest_cost) & (wlen == rest_len) & ~fresh[win])
+        )
+
+        # ---- tracker bookkeeping (exact PQueueTracker arithmetic).  The
+        # engine constricts BOTH kinds' trackers at the top of every pop
+        # iteration; the in-hand first pop (node 0) was already
+        # constricted and removed by the engine before the arena engaged.
+        def constrict_kind(k_, tr_):
+            def body_(args):
+                thr_, total_ = args
+                total_ = total_ - lc[k_, jnp.clip(thr_, 0, Lw - 1)]
+                return thr_ + 1, total_
+
+            thr_, total_ = lax.while_loop(
+                lambda a: ~first
+                & (a[1] > max_queue)
+                & (a[0] < tr_[k_, 2]),
+                body_,
+                (tr_[k_, 0], tr_[k_, 1]),
+            )
+            lcon_ = jnp.where(thr_ != tr_[k_, 0], 0, tr_[k_, 3])
+            return tr_.at[k_, 0].set(thr_).at[k_, 1].set(total_).at[
+                k_, 3
+            ].set(lcon_)
+
+        tr = constrict_kind(0, tr)
+        tr = constrict_kind(1, tr)
+
+        k = jnp.clip(kinds[win], 0, 1)
+        thr = tr[k, 0]
+        total_q = tr[k, 1]
+        far = tr[k, 2]
+        lcon = tr[k, 3]
+        discarded = (
+            (wtot > me_budget)
+            | (wlen < thr)
+            | (pc[k, jnp.clip(wlen, 0, Lw - 1)] >= cap)
+            | imb[win]
+        )
+
+        code = jnp.where(
+            rest_wins,
+            3,
+            jnp.where(
+                discarded,
+                7,
+                jnp.where(
+                    reach[win],
+                    2,
+                    jnp.where(
+                        dirty[win],
+                        1,
+                        jnp.where(nsteps >= step_limit, 4, 0),
+                    ),
+                ),
+            ),
+        )
+
+        # ---- commit: advance the winner's side(s) by its symbol(s)
+        s1 = 2 * win
+        s2 = s1 + 1
+        dual_w = is_dual[win]
+        sa = sym1s[win]
+        sb = sym2s[win]
+
+        D1n, e1n, rmin1n, er1n = _col_step(
+            D[s1], e[s1], rmin[s1], er[s1], offs[s1], act[s1], rlen, reads,
+            clen[s1] + 1, sa, wc, et, E,
+        )
+        D2n, e2n, rmin2n, er2n = _col_step(
+            D[s2], e[s2], rmin[s2], er[s2], offs[s2], act[s2], rlen, reads,
+            clen[s2] + 1, sb, wc, et, E,
+        )
+        ovf = (act[s1] & (e1n >= E)).any() | (
+            dual_w & (act[s2] & (e2n >= E)).any()
+        )
+        both2 = act[s1] & act[s2] & dual_w
+        act1n = act[s1] & ~(both2 & (e2n + delta < e1n))
+        act2n = act[s2] & ~(both2 & (e1n + delta < e2n))
+
+        commit = (code == 0) & ~ovf
+        code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
+
+        D = D.at[s1].set(jnp.where(commit, D1n, D[s1]))
+        e = e.at[s1].set(jnp.where(commit, e1n, e[s1]))
+        rmin = rmin.at[s1].set(jnp.where(commit, rmin1n, rmin[s1]))
+        er = er.at[s1].set(jnp.where(commit, er1n, er[s1]))
+        act = act.at[s1].set(jnp.where(commit, act1n, act[s1]))
+        cons = cons.at[s1].set(
+            jnp.where(
+                commit,
+                cons[s1].at[jnp.clip(clen[s1], 0, C - 1)].set(sa),
+                cons[s1],
+            )
+        )
+        clen = clen.at[s1].set(jnp.where(commit, clen[s1] + 1, clen[s1]))
+        dual_commit = commit & dual_w
+        D = D.at[s2].set(jnp.where(dual_commit, D2n, D[s2]))
+        e = e.at[s2].set(jnp.where(dual_commit, e2n, e[s2]))
+        rmin = rmin.at[s2].set(jnp.where(dual_commit, rmin2n, rmin[s2]))
+        er = er.at[s2].set(jnp.where(dual_commit, er2n, er[s2]))
+        act = act.at[s2].set(jnp.where(dual_commit, act2n, act[s2]))
+        cons = cons.at[s2].set(
+            jnp.where(
+                dual_commit,
+                cons[s2].at[jnp.clip(clen[s2], 0, C - 1)].set(sb),
+                cons[s2],
+            )
+        )
+        clen = clen.at[s2].set(
+            jnp.where(dual_commit, clen[s2] + 1, clen[s2])
+        )
+
+        # tracker commit: remove + process + insert (constriction above)
+        new_len = wlen + 1
+        li = jnp.clip(wlen, 0, Lw - 1)
+        lc_k = lc[k]
+        lc_k = jnp.where(first, lc_k, lc_k.at[li].add(-1))
+        total_q2 = jnp.where(
+            first, total_q, total_q - (wlen >= thr).astype(jnp.int32)
+        )
+        pc_k = pc[k].at[li].add(1)
+        ni = jnp.clip(new_len, 0, Lw - 1)
+        lc_k = lc_k.at[ni].add(1)
+        total_q2 = total_q2 + (new_len >= thr).astype(jnp.int32)
+        far2 = jnp.maximum(far, wlen)
+        lcon2 = lcon + 1
+
+        lc = jnp.where(commit, lc.at[k].set(lc_k), lc)
+        pc = jnp.where(commit, pc.at[k].set(pc_k), pc)
+        tr = jnp.where(
+            commit,
+            tr.at[k].set(jnp.stack([thr, total_q2, far2, lcon2])),
+            tr,
+        )
+
+        hist = jnp.where(
+            commit,
+            hist.at[jnp.clip(nsteps, 0, max_steps - 1)].set(
+                win.astype(jnp.int8)
+            ),
+            hist,
+        )
+        steps = jnp.where(commit, steps.at[win].add(1), steps)
+        nsteps = nsteps + commit.astype(jnp.int32)
+        seqv = jnp.where(commit, seqv.at[win].set(seq_ctr), seqv)
+        fresh = jnp.where(commit, fresh.at[win].set(False), fresh)
+        seq_ctr = seq_ctr + commit.astype(jnp.int32)
+        stop_node = win
+        return (
+            D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
+            nsteps, seqv, fresh, seq_ctr, code, stop_node,
+        )
+
+    init = (
+        state["D"][slots],
+        state["e"][slots],
+        state["rmin"][slots],
+        state["er"][slots],
+        state["act"][slots],
+        state["cons"][slots],
+        state["clen"][slots],
+        lc,
+        pc,
+        tr_scalars,
+        jnp.zeros((K,), jnp.int32),
+        jnp.zeros((max_steps,), jnp.int8),
+        jnp.int32(0),
+        seqv0,
+        jnp.arange(K) != 0,  # node 0's original entry is the in-hand pop
+        jnp.int32(K + 1),
+        jnp.int32(0),
+        jnp.int32(0),
+    )
+    (D, e, rmin, er, act, cons, clen, _lc, _pc, _tr, steps, hist,
+     nsteps, _seqv, _fresh, _ctr, code, stop_node) = lax.while_loop(
+        lambda c: c[16] == 0, body, init
+    )
+
+    eds, occ, split, reached = jax.vmap(
+        lambda D_, e_, rmin_, er_, off_, act_, clen_: _stats_core(
+            D_, e_, rmin_, er_, off_, act_, rlen, reads, clen_, num_symbols, E
+        )
+    )(D, e, rmin, er, offs, act, clen)
+
+    out = dict(state)
+    out["D"] = state["D"].at[slots].set(D)
+    out["e"] = state["e"].at[slots].set(e)
+    out["rmin"] = state["rmin"].at[slots].set(rmin)
+    out["er"] = state["er"].at[slots].set(er)
+    out["act"] = state["act"].at[slots].set(act)
+    out["cons"] = state["cons"].at[slots].set(cons)
+    out["clen"] = state["clen"].at[slots].set(clen)
+    return (
+        out, hist, nsteps, code, stop_node, steps,
+        (eds, occ, split, reached), act, cons, clen,
+    )
 
 
 @partial(jax.jit, static_argnames=("W",))
@@ -1214,6 +1638,168 @@ class JaxScorer(WavefrontScorer):
             act1_np[:n],
             act2_np[:n],
         )
+
+    #: fixed history capacity of the arena kernel (static shape: one
+    #: compiled kernel per geometry, dynamic step_limit rides in params)
+    ARENA_CAP = 512
+    #: node capacity of the arena kernel (static; dead-node padding).
+    #: Sized for the live-chain count of tie-heavy dual searches; per-
+    #: iteration compute scales with K but stays tiny for a TPU VPU
+    ARENA_K = 8
+
+    def run_arena(
+        self,
+        node_specs,        # [(h1, h2|None, len1, len2), ...] 1..ARENA_K
+        me_budget: int,
+        min_count: int,
+        ed_delta: int,
+        imb_min: int,
+        l2: bool,
+        weighted: bool,
+        rest_cost: int,
+        rest_len: int,
+        max_queue_size: int,
+        capacity_per_size: int,
+        step_limit: int,
+        lc: np.ndarray,    # [2, Lw] per-kind queue length counts
+        pc: np.ndarray,    # [2, Lw] per-kind processed counts
+        tr_scalars: np.ndarray,  # [2, 4] (thr, total, farthest, last_constr)
+    ):
+        """K-node pop arena (see ``_j_arena``); node 0 must be the
+        engine's in-hand pop, later nodes in their queue pop order.
+        Returns ``(hist, nsteps, code, stop_node, per_node_steps,
+        per_side_appended, per_side_stats, per_side_act)`` with sides
+        flattened as ``[n0s1, n0s2, n1s1, ...]`` (side-2 entries of
+        single nodes and all entries of padding nodes are None)."""
+        K = self.ARENA_K
+        n_live = len(node_specs)
+        if not 1 <= n_live <= K:
+            raise ValueError("arena takes 1..ARENA_K nodes")
+        kinds = []
+        slots = []
+        self._scratch_reset()
+        for h1, h2, _l1, _l2 in node_specs:
+            kinds.append(1 if h2 is not None else 0)
+            slots.append(self._slot_of[h1])
+            slots.append(
+                self._slot_of[h2] if h2 is not None else self._scratch_slot()
+            )
+        for _ in range(K - n_live):
+            kinds.append(-1)
+            slots.append(self._scratch_slot())
+            slots.append(self._scratch_slot())
+        if len(set(slots)) != 2 * K:
+            raise ValueError("arena requires distinct state slots")
+        step_limit = min(step_limit, self.ARENA_CAP)
+        max_len = max(max(s[2], s[3]) for s in node_specs)
+        while max_len + step_limit + 2 >= self._C:
+            self._grow_cons()
+        params = np.asarray(
+            [
+                min(me_budget, 2**31 - 1),
+                min_count,
+                ed_delta,
+                imb_min,
+                int(l2),
+                int(weighted),
+                min(rest_cost, 2**31 - 1),
+                rest_len,
+                n_live,
+                max_queue_size,
+                capacity_per_size,
+                step_limit,
+            ],
+            dtype=np.int32,
+        )
+        seqv0 = np.arange(K, dtype=np.int32)
+        state, hist, nsteps, code, stop_node, steps, stats, act, cons, clen = (
+            _j_arena(
+                self._state,
+                self._reads,
+                self._rlen,
+                params,
+                np.asarray(slots, dtype=np.int32),
+                np.asarray(kinds, dtype=np.int32),
+                seqv0,
+                np.asarray(tr_scalars, dtype=np.int32),
+                np.ascontiguousarray(lc, dtype=np.int32),
+                np.ascontiguousarray(pc, dtype=np.int32),
+                self._wc,
+                self._et,
+                self._A,
+                self.ARENA_CAP,
+                K,
+            )
+        )
+        self._state = state
+        (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
+         cons_np) = jax.device_get(
+            (hist, nsteps, code, stop_node, steps, stats, act, cons)
+        )
+        nsteps = int(nsteps)
+        code = int(code)
+        stop_node = int(stop_node)
+        self.counters["arena_calls"] = self.counters.get("arena_calls", 0) + 1
+        self.counters["arena_steps"] = (
+            self.counters.get("arena_steps", 0) + nsteps
+        )
+        key = f"arena_stop_{code}"
+        self.counters[key] = self.counters.get(key, 0) + 1
+
+        appended = []
+        sides_stats = []
+        sides_act = []
+        n = self.num_reads
+        for f in range(2 * K):
+            node = f // 2
+            if node >= n_live or (f % 2 == 1 and kinds[node] == 0):
+                appended.append(None)
+                sides_stats.append(None)
+                sides_act.append(None)
+                continue
+            k_steps = int(steps_np[node])
+            l0 = node_specs[node][2 + (f % 2)]
+            ids = cons_np[f, l0 : l0 + k_steps]
+            appended.append(self.symtab[ids].astype(np.uint8).tobytes())
+            sides_stats.append(
+                self._stats_np(
+                    (
+                        stats_np[0][f],
+                        stats_np[1][f],
+                        stats_np[2][f],
+                        stats_np[3][f],
+                    )
+                )
+            )
+            sides_act.append(act_np[f, :n])
+        if code == 5:
+            self._grow_e()
+        return (
+            hist_np[:nsteps],
+            nsteps,
+            code,
+            stop_node,
+            [int(s) for s in steps_np],
+            appended,
+            sides_stats,
+            sides_act,
+        )
+
+    def _scratch_reset(self) -> None:
+        self._scratch_next = 0
+
+    def _scratch_slot(self) -> int:
+        """Dedicated slots backing the unused side-2 rows of single-kind
+        arena nodes and both rows of padding nodes (content is scratch;
+        the pool keeps each use in one call distinct so the output
+        scatter never writes one slot twice)."""
+        if not hasattr(self, "_scratch"):
+            self._scratch = [
+                self._alloc()[1] for _ in range(2 * self.ARENA_K)
+            ]
+        slot = self._scratch[self._scratch_next]
+        self._scratch_next += 1
+        return slot
 
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
         self.counters["finalize_calls"] += 1

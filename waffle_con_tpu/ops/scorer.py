@@ -286,6 +286,16 @@ class SubsetScorer(WavefrontScorer):
             self.run_extend = None  # type: ignore[assignment]
         if not hasattr(base, "run_extend_dual"):
             self.run_extend_dual = None  # type: ignore[assignment]
+        if not hasattr(base, "run_arena"):
+            self.run_arena = None  # type: ignore[assignment]
+
+    @property
+    def ARENA_CAP(self):
+        return self.base.ARENA_CAP
+
+    @property
+    def ARENA_K(self):
+        return self.base.ARENA_K
 
     @property
     def counters(self):
@@ -366,6 +376,19 @@ class SubsetScorer(WavefrontScorer):
             self._slice(stats2),
             act1[idx],
             act2[idx],
+        )
+
+    def run_arena(self, *args, **kwargs):
+        (hist, nsteps, code, stop_node, node_steps, appended,
+         sides_stats, sides_act) = self.base.run_arena(*args, **kwargs)
+        idx = self.indices
+        sides_stats = [
+            self._slice(s) if s is not None else None for s in sides_stats
+        ]
+        sides_act = [a[idx] if a is not None else None for a in sides_act]
+        return (
+            hist, nsteps, code, stop_node, node_steps, appended,
+            sides_stats, sides_act,
         )
 
 
