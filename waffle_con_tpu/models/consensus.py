@@ -141,6 +141,56 @@ def replay_run_bookkeeping(
     return farthest, last_constraint
 
 
+def replay_arena_history(hist, lens, kinds, trackers, far, lcon, cfg, on_length=None):
+    """Replay a device arena's committed interleaved pop sequence onto the
+    real tracker objects — the ONE copy of the per-pop bookkeeping both
+    engines' arena paths share (mirrors the engines' pop order: constrict
+    every kind, remove, process, insert; the in-hand first pop was
+    already constricted and removed before the arena engaged).
+
+    ``lens``/``far``/``lcon`` are mutated in place (``lens`` per node;
+    ``far``/``lcon`` per kind, matching ``trackers``)."""
+    for i, which in enumerate(hist):
+        which = int(which)
+        k = kinds[which]
+        length = lens[which]
+        if i > 0:
+            for kk in range(len(trackers)):
+                while (
+                    len(trackers[kk]) > cfg.max_queue_size
+                    or lcon[kk] >= cfg.max_nodes_wo_constraint
+                ) and trackers[kk].threshold() < far[kk]:
+                    trackers[kk].increment_threshold()
+                    lcon[kk] = 0
+            trackers[k].remove(length)
+        far[k] = max(far[k], length)
+        lcon[k] += 1
+        trackers[k].process(length)
+        trackers[k].insert(length + 1)
+        if on_length is not None:
+            on_length(length)
+        lens[which] += 1
+
+
+def requeue_arena_nodes(pqueue, nodes, taken, node_steps, hist, cost, on_duplicate):
+    """Re-queue arena participants preserving insertion order: extended
+    nodes re-enter in the order of their LAST arena pop (later pop ->
+    newer insertion seq); never-popped competitors keep their original
+    seq (FIFO tie order).  ``on_duplicate(idx, node)`` handles the rare
+    key collision (drop the newcomer, undo its replayed tracker insert)."""
+    last_pop = {}
+    for i, which in enumerate(hist):
+        last_pop[int(which)] = i
+    for i, (cand, pri, seq) in enumerate(taken, start=1):
+        if node_steps[i] == 0:
+            ok = pqueue.push_restored(cand.key(), cand, pri, seq)
+            check_invariant(ok, "arena restore unique")
+    for idx in sorted(last_pop, key=last_pop.get):
+        nd = nodes[idx]
+        if not pqueue.push(nd.key(), nd, nd.priority(cost)):
+            on_duplicate(idx, nd)
+
+
 def candidates_from_stats(
     stats: BranchStats,
     symtab: np.ndarray,
@@ -343,6 +393,24 @@ class ConsensusDWFA:
                     if node.prefetch is not None
                     else self._nominate(scorer, node)
                 )
+                # -- arena fast path: resolve the pop competition among
+                # the in-hand node and the next-best queue entries on
+                # device (see DualConsensusDWFA._arena_attempt)
+                if (
+                    len(passing_now) == 1
+                    and getattr(scorer, "run_arena", None) is not None
+                ):
+                    arena = self._arena_attempt(
+                        scorer, pqueue, node, maximum_error,
+                        activate_points, cost, tracker,
+                        farthest_consensus, last_constraint,
+                    )
+                    if arena is not None:
+                        farthest_consensus, last_constraint, arena_steps = (
+                            arena
+                        )
+                        nodes_explored += arena_steps
+                        continue
                 best_other = pqueue.peek_priority()
                 other_cost = 2**31 - 1
                 other_len = 0
@@ -500,6 +568,121 @@ class ConsensusDWFA:
         return results
 
     # ------------------------------------------------------------------
+
+    def _arena_attempt(
+        self, scorer, pqueue, node, maximum_error, activate_points, cost,
+        tracker, farthest_consensus, last_constraint,
+    ):
+        """Single-engine device pop arena (dual twin:
+        ``DualConsensusDWFA._arena_attempt``): the in-hand node plus up
+        to ``ARENA_K - 1`` next-best queue entries extend on device under
+        the exact pop/tracker semantics.  Returns ``None`` when not
+        engaged (competitors restored with their original insertion
+        order), else ``(farthest_consensus, last_constraint, steps)``."""
+        cfg = self.config
+        if pqueue.is_empty():
+            return None  # no competitor: the plain run path is strictly better
+        taken = []
+        while len(taken) < scorer.ARENA_K - 1 and not pqueue.is_empty():
+            taken.append(pqueue.pop_with_seq())
+        nodes = [node] + [t[0] for t in taken]
+
+        def restore_all():
+            for cand, pri, seq in taken:
+                pqueue.push_restored(cand.key(), cand, pri, seq)
+
+        step_limit = scorer.ARENA_CAP
+        for nd in nodes:
+            nl = len(nd.consensus)
+            next_act = min((l for l in activate_points if l > nl), default=None)
+            if next_act is not None:
+                step_limit = min(step_limit, next_act - nl - 1)
+        step_limit = min(
+            step_limit, cfg.max_nodes_wo_constraint - last_constraint - 1
+        )
+        if step_limit < 1:
+            restore_all()
+            return None
+
+        rest = pqueue.peek_priority()
+        rest_cost = 2**31 - 1
+        rest_len = 0
+        if rest is not None:
+            rest_cost = -rest[0]
+            rest_len = rest[1]
+
+        needed = (
+            max(
+                max(len(nd.consensus) for nd in nodes),
+                farthest_consensus,
+            )
+            + scorer.ARENA_CAP
+            + 4
+        )
+        win_len = 1 << (needed - 1).bit_length()
+        lc, pc = tracker.export_windows(win_len)
+        zeros = np.zeros(win_len, dtype=np.int32)
+        tr_scalars = [
+            [
+                tracker.threshold(), len(tracker),
+                farthest_consensus, last_constraint,
+            ],
+            [0, 0, 0, 0],  # no second node kind in the single engine
+        ]
+        me_budget = (
+            int(maximum_error) if maximum_error != math.inf else 2**31 - 1
+        )
+        (hist, nsteps, _code, _stop_node, node_steps, appended,
+         sides_stats, _sides_act) = scorer.run_arena(
+            [(nd.handle, None, len(nd.consensus), 0) for nd in nodes],
+            me_budget,
+            cfg.min_count,
+            0,
+            0,
+            cost is ConsensusCost.L2_DISTANCE,
+            False,
+            rest_cost,
+            rest_len,
+            cfg.max_queue_size,
+            cfg.max_capacity_per_size,
+            step_limit,
+            np.stack([lc, zeros]),
+            np.stack([pc, zeros]),
+            np.asarray(tr_scalars, dtype=np.int32),
+        )
+        if nsteps == 0:
+            restore_all()
+            return None
+
+        for i, nd in enumerate(nodes):
+            if node_steps[i] > 0:
+                self._drop_prefetch(scorer, nd)
+
+        # exact tracker replay of the committed interleaved pop sequence
+        lens = [len(nd.consensus) for nd in nodes]
+        far = [farthest_consensus]
+        lcon = [last_constraint]
+        replay_arena_history(
+            hist, lens, [0] * len(nodes), [tracker], far, lcon, cfg
+        )
+
+        for i, nd in enumerate(nodes):
+            if node_steps[i] == 0:
+                continue
+            nd.consensus = nd.consensus + appended[2 * i]
+            nd.stats = sides_stats[2 * i]
+
+        def on_duplicate(_idx, nd):
+            # converged to an existing key: drop the newcomer and undo
+            # its replayed tracker insert (cf. the expansion path)
+            logger.warning("duplicate search node (arena re-queue)")
+            tracker.remove(len(nd.consensus))
+            scorer.free(nd.handle)
+
+        requeue_arena_nodes(
+            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate
+        )
+        return far[0], lcon[0], int(nsteps)
 
     def _nominate(self, scorer: WavefrontScorer, node: _Node) -> List[int]:
         """Passing extension symbols for a node — a pure function of its

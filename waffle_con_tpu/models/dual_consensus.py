@@ -23,7 +23,9 @@ from waffle_con_tpu.models.consensus import (
     Consensus,
     EngineError,
     candidates_from_stats,
+    replay_arena_history,
     replay_run_bookkeeping,
+    requeue_arena_nodes,
     shift_offsets,
     check_invariant,
 )
@@ -826,28 +828,13 @@ class DualConsensusDWFA:
         far = [farthest_single, farthest_dual]
         lcon = [single_last_constraint, dual_last_constraint]
         trackers = (single_tracker, dual_tracker)
-        for i, which in enumerate(hist):
-            which = int(which)
-            k = kinds[which]
-            length = lens[which]
-            if i > 0:
-                for kk in (0, 1):
-                    while (
-                        len(trackers[kk]) > cfg.max_queue_size
-                        or lcon[kk] >= cfg.max_nodes_wo_constraint
-                    ) and trackers[kk].threshold() < far[kk]:
-                        trackers[kk].increment_threshold()
-                        lcon[kk] = 0
-                trackers[k].remove(length)
-            far[k] = max(far[k], length)
-            lcon[k] += 1
-            trackers[k].process(length)
-            trackers[k].insert(length + 1)
-            _extend_active_tables(
+        replay_arena_history(
+            hist, lens, kinds, trackers, far, lcon, cfg,
+            on_length=lambda length: _extend_active_tables(
                 cfg, activate_points, total_active_count, active_min_count,
                 length,
-            )
-            lens[which] += 1
+            ),
+        )
         # kind-split step attribution for the engagement metrics
         arena_dual = sum(1 for w in hist if kinds[int(w)] == 1)
         scorer.counters["arena_dual_steps"] = (
@@ -880,22 +867,17 @@ class DualConsensusDWFA:
         # re-queue: extended nodes re-enter in the order of their LAST
         # arena pop (later pop -> newer insertion seq); never-popped
         # competitors keep their original seq (FIFO tie order preserved)
-        last_pop = {}
-        for i, which in enumerate(hist):
-            last_pop[int(which)] = i
-        for i, (cand, pri, seq) in enumerate(taken, start=1):
-            if node_steps[i] == 0:
-                ok = pqueue.push_restored(cand.key(), cand, pri, seq)
-                check_invariant(ok, "arena restore unique")
-        for idx in sorted(last_pop, key=last_pop.get):
-            nd = nodes[idx]
-            if not pqueue.push(nd.key(), nd, nd.priority(cost)):
-                # two nodes converged to one key: handled like every other
-                # insertion path (_queue_child) — drop the newcomer and
-                # undo its replayed tracker insert
-                logger.warning("duplicate dual search node (arena re-queue)")
-                trackers[kinds[idx]].remove(nd.max_consensus_length())
-                self._free_node(scorer, nd)
+        def on_duplicate(idx, nd):
+            # two nodes converged to one key: handled like every other
+            # insertion path (_queue_child) — drop the newcomer and
+            # undo its replayed tracker insert
+            logger.warning("duplicate dual search node (arena re-queue)")
+            trackers[kinds[idx]].remove(nd.max_consensus_length())
+            self._free_node(scorer, nd)
+
+        requeue_arena_nodes(
+            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate
+        )
         return far[0], far[1], lcon[0], lcon[1], int(nsteps)
 
     # ==================================================================
