@@ -98,16 +98,29 @@ def _init_col(off, act, rlen, E, W):
     return D, e, rmin, er
 
 
-def _col_step(D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E):
+def _read_window(reads_pad, start, R, W):
+    """One ``[R, W]`` window of the W-left-padded reads array whose row
+    ``r`` holds ``reads[r, x - W]``: a single ``dynamic_slice`` — the TPU
+    fast path replacing per-lane ``take_along_axis`` gathers (measured
+    ~2.7 ms/step vs ~0 for the slice at north-star shapes).  Clipping the
+    start is safe: it only engages when every in-band read position is
+    already out of range, and those lanes are masked invalid."""
+    Lp = reads_pad.shape[1]
+    return lax.dynamic_slice(
+        reads_pad, (0, jnp.clip(start, 0, Lp - W)), (R, W)
+    )
+
+
+def _col_step_w(D, e, rmin, er, off, act, rlen, bchar, jnew, sym, wc, et, E):
     """Advance one branch's banded columns from ``jnew-1`` to ``jnew`` by
-    consuming consensus symbol ``sym``; returns updated (D, e, rmin, er)
-    with inactive reads passed through unchanged."""
+    consuming consensus symbol ``sym``, with the read window ``bchar``
+    (``bchar[r, t] == reads[r, i_new - 1]`` wherever ``i_new`` is in
+    range) already fetched; returns updated (D, e, rmin, er) with
+    inactive reads passed through unchanged."""
     R, W = D.shape
-    L = reads.shape[1]
     t = jnp.arange(W, dtype=jnp.int32)[None, :]
     i_new = jnew - off[:, None] - E + t
 
-    bchar = jnp.take_along_axis(reads, jnp.clip(i_new - 1, 0, L - 1), axis=1)
     sub = ((bchar != sym) & (bchar != wc)).astype(jnp.int32)
 
     diag = D + sub
@@ -139,15 +152,42 @@ def _col_step(D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E):
     return D, e, rmin, er
 
 
-def _stats_core(D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E):
-    """Snapshot of one branch: per-read edit distance, tip votes over dense
-    symbols, reached flags (reference overshoot semantics)."""
-    R, W = D.shape
+def _col_step(D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E):
+    """Gather-sourced :func:`_col_step_w` (per-lane window positions; the
+    general path for branches with non-uniform per-read offsets)."""
+    W = D.shape[1]
     L = reads.shape[1]
+    t = jnp.arange(W, dtype=jnp.int32)[None, :]
+    i_new = jnew - off[:, None] - E + t
+    bchar = jnp.take_along_axis(reads, jnp.clip(i_new - 1, 0, L - 1), axis=1)
+    return _col_step_w(
+        D, e, rmin, er, off, act, rlen, bchar, jnew, sym, wc, et, E
+    )
+
+
+def _col_step_u(
+    D, e, rmin, er, off, act, rlen, reads_pad, jnew, off0, sym, wc, et, E
+):
+    """Slice-sourced :func:`_col_step_w` for branches whose ACTIVE reads
+    all share offset ``off0``: the window start is lane-independent, so
+    one ``dynamic_slice`` replaces the gather (inactive lanes read
+    misaligned bytes, which the active-mask discards)."""
+    R, W = D.shape
+    bchar = _read_window(reads_pad, W + jnew - 1 - off0 - E, R, W)
+    return _col_step_w(
+        D, e, rmin, er, off, act, rlen, bchar, jnew, sym, wc, et, E
+    )
+
+
+def _stats_core_w(D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E):
+    """Snapshot of one branch: per-read edit distance, tip votes over dense
+    symbols, reached flags (reference overshoot semantics).  ``vchar`` is
+    the read window at the tip column (``vchar[r, t] == reads[r, i]``
+    wherever ``i`` is in range)."""
+    R, W = D.shape
     t = jnp.arange(W, dtype=jnp.int32)[None, :]
     i = clen - off[:, None] - E + t
     tip = act[:, None] & (D <= e[:, None]) & (i >= 0) & (i < rlen[:, None])
-    vchar = jnp.take_along_axis(reads, jnp.clip(i, 0, L - 1), axis=1)
     onehot = (vchar[:, :, None] == jnp.arange(num_symbols)[None, None, :]) & tip[
         :, :, None
     ]
@@ -156,6 +196,29 @@ def _stats_core(D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E):
     reached = act & (er < INF) & (e == er)
     eds = jnp.where(act, e, 0)
     return eds, occ, split, reached
+
+
+def _stats_core(D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E):
+    """Gather-sourced :func:`_stats_core_w` (general offsets path)."""
+    W = D.shape[1]
+    L = reads.shape[1]
+    t = jnp.arange(W, dtype=jnp.int32)[None, :]
+    i = clen - off[:, None] - E + t
+    vchar = jnp.take_along_axis(reads, jnp.clip(i, 0, L - 1), axis=1)
+    return _stats_core_w(
+        D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E
+    )
+
+
+def _stats_core_u(
+    D, e, rmin, er, off, act, rlen, reads_pad, clen, off0, num_symbols, E
+):
+    """Slice-sourced :func:`_stats_core_w` (uniform active offsets)."""
+    R, W = D.shape
+    vchar = _read_window(reads_pad, W + clen - off0 - E, R, W)
+    return _stats_core_w(
+        D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E
+    )
 
 
 # ======================================================================
@@ -370,12 +433,21 @@ def _j_finalize(state, h):
     return jnp.where(act, jnp.minimum(fin, INF), 0), overflow
 
 
-@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
-def _j_run(state, reads, rlen, params, wc, et, num_symbols):
+@partial(
+    jax.jit, static_argnames=("num_symbols", "uniform"), donate_argnums=(0,)
+)
+def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+           uniform):
     """Device-resident multi-symbol extension: keep appending the unique
     passing candidate while the votes are exactly reproducible host-side
     (one tip symbol per read → integer counts), stopping at any event the
     host search must arbitrate.
+
+    ``uniform`` (static) selects the window-sourcing path: True when the
+    host's offset mirror shows every ACTIVE read of the branch at the
+    same offset ``off0`` (``params[7]``) — read windows then come from
+    one ``dynamic_slice`` of ``reads_pad`` per step instead of per-lane
+    gathers (the dominant cost at north-star scale on TPU).
 
     The run continues only while the node would keep winning pops against
     the best other queued entry ``(other_cost, other_len)`` under the
@@ -403,17 +475,36 @@ def _j_run(state, reads, rlen, params, wc, et, num_symbols):
     min_count = params[4]
     l2 = params[5].astype(bool)
     max_steps = params[6]
+    off0 = params[7]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
     off = state["off"][h]
     act = state["act"][h]
 
-    def body(carry):
-        D, e, rmin, er, cons, clen, steps, _code = carry
-        eds, occ, split, reached = _stats_core(
+    def stats_at(D, e, rmin, er, clen):
+        if uniform:
+            return _stats_core_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, clen, off0,
+                num_symbols, E,
+            )
+        return _stats_core(
             D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
         )
+
+    def col_at(D, e, rmin, er, jnew, sym):
+        if uniform:
+            return _col_step_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, jnew, off0, sym,
+                wc, et, E,
+            )
+        return _col_step(
+            D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
+        )
+
+    def body(carry):
+        D, e, rmin, er, cons, clen, steps, _code = carry
+        eds, occ, split, reached = stats_at(D, e, rmin, er, clen)
         # int32-safe cost total: with L2 and huge per-read distances the
         # squared sum could wrap, so treat that regime as a host event
         costs = jnp.where(l2, eds * eds, eds)
@@ -486,9 +577,7 @@ def _j_run(state, reads, rlen, params, wc, et, num_symbols):
         sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(jnp.int32)
         cons2 = cons.at[jnp.clip(clen, 0, C - 1)].set(sym)
         clen2 = clen + 1
-        D2, e2, rmin2, er2 = _col_step(
-            D, e, rmin, er, off, act, rlen, reads, clen2, sym, wc, et, E
-        )
+        D2, e2, rmin2, er2 = col_at(D, e, rmin, er, clen2, sym)
         ovf = (act & (e2 >= E)).any()
         commit = (code == 0) & ~ovf
         code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
@@ -514,9 +603,7 @@ def _j_run(state, reads, rlen, params, wc, et, num_symbols):
     D, e, rmin, er, cons, clen, steps, code = lax.while_loop(
         lambda c: c[7] == 0, body, init
     )
-    stats = _stats_core(
-        D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
-    )
+    stats = stats_at(D, e, rmin, er, clen)
     out = dict(state)
     out["D"] = state["D"].at[h].set(D)
     out["e"] = state["e"].at[h].set(e)
@@ -558,12 +645,19 @@ def _dual_votes(occ, split, w, wc, weighted):
     return counts, has_votes, n_cands, exactable
 
 
-@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
-def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
+@partial(
+    jax.jit, static_argnames=("num_symbols", "uniform"), donate_argnums=(0,)
+)
+def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+                uniform):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
     with divergence pruning (``dual_max_ed_delta``) applied on device
     exactly as the host would (integer compares on post-push distances).
+
+    ``uniform`` (static) selects slice- vs gather-sourced read windows
+    (see ``_j_run``); ``params[11]``/``params[12]`` carry each side's
+    shared active-read offset when uniform.
 
     Preconditions (enforced by the engine): neither side locked, and
     ``min_af == 0`` so the vote thresholds are static.
@@ -596,6 +690,8 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
     l2 = params[8].astype(bool)
     weighted = params[9].astype(bool)
     max_steps = params[10]
+    off0a = params[11]
+    off0b = params[12]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -604,15 +700,35 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
     EPS = VOTE_EPS
     min_count_f = min_count.astype(jnp.float32)
 
+    def stats_at(D, e, rmin, er, off, act, clen, off0):
+        if uniform:
+            return _stats_core_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, clen, off0,
+                num_symbols, E,
+            )
+        return _stats_core(
+            D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
+        )
+
+    def col_at(D, e, rmin, er, off, act, jnew, off0, sym):
+        if uniform:
+            return _col_step_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, jnew, off0, sym,
+                wc, et, E,
+            )
+        return _col_step(
+            D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
+        )
+
     def body(carry):
         (Da, ea, rmina, era, acta, consa, clena,
          Db, eb, rminb, erb, actb, consb, clenb, steps, _code) = carry
 
-        edsa, occa, splita, reacheda = _stats_core(
-            Da, ea, rmina, era, offa, acta, rlen, reads, clena, num_symbols, E
+        edsa, occa, splita, reacheda = stats_at(
+            Da, ea, rmina, era, offa, acta, clena, off0a
         )
-        edsb, occb, splitb, reachedb = _stats_core(
-            Db, eb, rminb, erb, offb, actb, rlen, reads, clenb, num_symbols, E
+        edsb, occb, splitb, reachedb = stats_at(
+            Db, eb, rminb, erb, offb, actb, clenb, off0b
         )
 
         # total node cost = per read, best over its tracked sides
@@ -709,13 +825,11 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
 
         consa2 = consa.at[jnp.clip(clena, 0, C - 1)].set(sym_a)
         consb2 = consb.at[jnp.clip(clenb, 0, C - 1)].set(sym_b)
-        Da2, ea2, rmina2, era2 = _col_step(
-            Da, ea, rmina, era, offa, acta, rlen, reads, clena + 1, sym_a,
-            wc, et, E,
+        Da2, ea2, rmina2, era2 = col_at(
+            Da, ea, rmina, era, offa, acta, clena + 1, off0a, sym_a
         )
-        Db2, eb2, rminb2, erb2 = _col_step(
-            Db, eb, rminb, erb, offb, actb, rlen, reads, clenb + 1, sym_b,
-            wc, et, E,
+        Db2, eb2, rminb2, erb2 = col_at(
+            Db, eb, rminb, erb, offb, actb, clenb + 1, off0b, sym_b
         )
         ovf = ((acta & (ea2 >= E)) | (actb & (eb2 >= E))).any()
 
@@ -762,12 +876,8 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
      Db, eb, rminb, erb, actb, consb, clenb, steps, code) = lax.while_loop(
         lambda c: c[15] == 0, body, init
     )
-    stats_a = _stats_core(
-        Da, ea, rmina, era, offa, acta, rlen, reads, clena, num_symbols, E
-    )
-    stats_b = _stats_core(
-        Db, eb, rminb, erb, offb, actb, rlen, reads, clenb, num_symbols, E
-    )
+    stats_a = stats_at(Da, ea, rmina, era, offa, acta, clena, off0a)
+    stats_b = stats_at(Db, eb, rminb, erb, offb, actb, clenb, off0b)
     out = dict(state)
     out["D"] = state["D"].at[ha].set(Da).at[hb].set(Db)
     out["e"] = state["e"].at[ha].set(ea).at[hb].set(eb)
@@ -781,12 +891,12 @@ def _j_run_dual(state, reads, rlen, params, wc, et, num_symbols):
 
 @partial(
     jax.jit,
-    static_argnames=("num_symbols", "max_steps", "K"),
+    static_argnames=("num_symbols", "max_steps", "K", "uniform"),
     donate_argnums=(0,),
 )
 def _j_arena(
-    state, reads, rlen, params, slots, kinds, seqv0, tr_scalars, lc, pc,
-    wc, et, num_symbols, max_steps, K,
+    state, reads, reads_pad, rlen, params, slots, kinds, seqv0, off0s,
+    tr_scalars, lc, pc, wc, et, num_symbols, max_steps, K, uniform,
 ):
     """K-node pop ARENA: resolve the pop competition among the K best
     runnable queue entries entirely on device.
@@ -855,6 +965,43 @@ def _j_arena(
 
     offs = state["off"][slots]       # [2K, R]
     live = jnp.arange(K) < n_live    # [K]
+
+    def stats_all(D, e, rmin, er, act, clen):
+        """Per-side snapshots [2K, ...]; with ``uniform`` (static) the 2K
+        read windows are unrolled ``dynamic_slice``s of ``reads_pad``
+        (each side's active reads share offset ``off0s[side]``) instead
+        of per-lane gathers — the arena's dominant per-iteration cost."""
+        if uniform:
+            vchars = jnp.stack(
+                [
+                    _read_window(reads_pad, W + clen[s] - off0s[s] - E, R, W)
+                    for s in range(2 * K)
+                ]
+            )
+            return jax.vmap(
+                lambda D_, e_, rmin_, er_, off_, act_, vchar_, clen_: (
+                    _stats_core_w(
+                        D_, e_, rmin_, er_, off_, act_, rlen, vchar_,
+                        clen_, num_symbols, E,
+                    )
+                )
+            )(D, e, rmin, er, offs, act, vchars, clen)
+        return jax.vmap(
+            lambda D_, e_, rmin_, er_, off_, act_, clen_: _stats_core(
+                D_, e_, rmin_, er_, off_, act_, rlen, reads, clen_,
+                num_symbols, E,
+            )
+        )(D, e, rmin, er, offs, act, clen)
+
+    def col_side(D, e, rmin, er, off, act, jnew, off0, sym):
+        if uniform:
+            return _col_step_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, jnew, off0, sym,
+                wc, et, E,
+            )
+        return _col_step(
+            D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
+        )
     is_dual = kinds == 1             # [K]
     min_count_f = min_count.astype(jnp.float32)
     EPS = VOTE_EPS
@@ -941,12 +1088,7 @@ def _j_arena(
         (D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
          nsteps, seqv, fresh, seq_ctr, _code, _stop_node) = carry
 
-        eds, occ, split, reached = jax.vmap(
-            lambda D_, e_, rmin_, er_, off_, act_, clen_: _stats_core(
-                D_, e_, rmin_, er_, off_, act_, rlen, reads, clen_,
-                num_symbols, E,
-            )
-        )(D, e, rmin, er, offs, act, clen)
+        eds, occ, split, reached = stats_all(D, e, rmin, er, act, clen)
 
         totals, lens, reach, dirty, sym1s, sym2s, imb = jax.vmap(node_eval)(
             is_dual,
@@ -1052,13 +1194,13 @@ def _j_arena(
         sa = sym1s[win]
         sb = sym2s[win]
 
-        D1n, e1n, rmin1n, er1n = _col_step(
-            D[s1], e[s1], rmin[s1], er[s1], offs[s1], act[s1], rlen, reads,
-            clen[s1] + 1, sa, wc, et, E,
+        D1n, e1n, rmin1n, er1n = col_side(
+            D[s1], e[s1], rmin[s1], er[s1], offs[s1], act[s1],
+            clen[s1] + 1, off0s[s1], sa,
         )
-        D2n, e2n, rmin2n, er2n = _col_step(
-            D[s2], e[s2], rmin[s2], er[s2], offs[s2], act[s2], rlen, reads,
-            clen[s2] + 1, sb, wc, et, E,
+        D2n, e2n, rmin2n, er2n = col_side(
+            D[s2], e[s2], rmin[s2], er[s2], offs[s2], act[s2],
+            clen[s2] + 1, off0s[s2], sb,
         )
         ovf = (act[s1] & (e1n >= E)).any() | (
             dual_w & (act[s2] & (e2n >= E)).any()
@@ -1166,11 +1308,7 @@ def _j_arena(
         lambda c: c[16] == 0, body, init
     )
 
-    eds, occ, split, reached = jax.vmap(
-        lambda D_, e_, rmin_, er_, off_, act_, clen_: _stats_core(
-            D_, e_, rmin_, er_, off_, act_, rlen, reads, clen_, num_symbols, E
-        )
-    )(D, e, rmin, er, offs, act, clen)
+    eds, occ, split, reached = stats_all(D, e, rmin, er, act, clen)
 
     out = dict(state)
     out["D"] = state["D"].at[slots].set(D)
@@ -1267,6 +1405,7 @@ class JaxScorer(WavefrontScorer):
         for i, r in enumerate(self.reads):
             reads_arr[i, : len(r)] = [self.sym_id[b] for b in r]
             rlen[i] = len(r)
+        self._reads_host = reads_arr
         self._reads = jax.device_put(reads_arr)
         self._rlen = jax.device_put(rlen)
 
@@ -1288,7 +1427,14 @@ class JaxScorer(WavefrontScorer):
             self._E = self.INITIAL_E
         self._B = self.INITIAL_SLOTS
         self._C = max(_next_pow2(max_len + 64), self.MIN_C)
+        self._stage_reads_pad()
         self._state = self._blank_state()
+        #: host mirrors of the per-slot offset/active device state: the
+        #: run kernels' dynamic-slice fast path needs to know — WITHOUT a
+        #: device round trip — whether a branch's active reads share one
+        #: offset (they do except after windowed late-read activation)
+        self._off_host = np.zeros((self._B, self._R), dtype=np.int32)
+        self._act_host = np.zeros((self._B, self._R), dtype=bool)
         self._free: List[int] = list(range(self._B))
         self._next_handle = 0
         self._slot_of = {}
@@ -1334,6 +1480,21 @@ class JaxScorer(WavefrontScorer):
         }
         return jax.device_put(host)
 
+    def _stage_reads_pad(self) -> None:
+        """Stage the W-left-padded reads copy backing the run kernels'
+        ``dynamic_slice`` window path (rebuilt on band growth: the pad
+        width is the band width).  ``-1`` filler never matches a symbol
+        or the wildcard, and every out-of-range lane is masked anyway."""
+        W = self._W
+        pad = np.full((self._R, self._L + 2 * W), -1, dtype=np.int32)
+        pad[:, W : W + self._L] = self._reads_host
+        if self._shardings is not None and "_reads_pad" in self._shardings:
+            self._reads_pad = jax.device_put(
+                pad, self._shardings["_reads_pad"]
+            )
+        else:
+            self._reads_pad = jax.device_put(pad)
+
     def _place(self) -> None:
         """Re-apply the mesh sharding (if any) after a geometry change —
         freshly built arrays default to single-device placement."""
@@ -1357,6 +1518,7 @@ class JaxScorer(WavefrontScorer):
         )
         self._state = dict(st, D=D, e=e, rmin=rmin, er=er)
         self._place()
+        self._stage_reads_pad()
 
     def _grow_slots(self) -> None:
         old_b = self._B
@@ -1364,6 +1526,11 @@ class JaxScorer(WavefrontScorer):
         self._state = _j_grow_slots(self._state, new_b=self._B)
         self._place()
         self._free.extend(range(old_b, self._B))
+        grow = lambda m, fill: np.concatenate(  # noqa: E731
+            [m, np.full((self._B - old_b, self._R), fill, m.dtype)]
+        )
+        self._off_host = grow(self._off_host, 0)
+        self._act_host = grow(self._act_host, False)
 
     def _grow_cons(self) -> None:
         self._C *= 2
@@ -1386,6 +1553,8 @@ class JaxScorer(WavefrontScorer):
         act = np.zeros(self._R, dtype=bool)
         act[: len(active)] = active
         self._state = _j_root(self._state, self._rlen, np.int32(slot), act)
+        self._off_host[slot] = 0
+        self._act_host[slot] = act
         return handle
 
     def clone(self, h: int) -> int:
@@ -1393,6 +1562,8 @@ class JaxScorer(WavefrontScorer):
         src = self._slot_of[h]
         handle, dst = self._alloc()
         self._state = _j_clone(self._state, np.int32(src), np.int32(dst))
+        self._off_host[dst] = self._off_host[src]
+        self._act_host[dst] = self._act_host[src]
         return handle
 
     def clone_many(self, hs: List[int]) -> List[int]:
@@ -1410,6 +1581,9 @@ class JaxScorer(WavefrontScorer):
         self._state = _j_clone_batch(
             self._state, np.asarray([srcs, dsts], dtype=np.int32)
         )
+        n = len(hs)
+        self._off_host[dsts[:n]] = self._off_host[srcs[:n]]
+        self._act_host[dsts[:n]] = self._act_host[srcs[:n]]
         return handles
 
     def free(self, h: int) -> None:
@@ -1473,6 +1647,8 @@ class JaxScorer(WavefrontScorer):
     ) -> None:
         self.counters["activate_calls"] += 1
         slot = self._slot_of[h]
+        self._off_host[slot, read_index] = offset
+        self._act_host[slot, read_index] = True
         params = np.asarray([slot, read_index, offset], dtype=np.int32)
         while True:
             state, overflow = _j_activate(
@@ -1487,6 +1663,7 @@ class JaxScorer(WavefrontScorer):
 
     def deactivate(self, h: int, read_index: int) -> None:
         slot = self._slot_of[h]
+        self._act_host[slot, read_index] = False
         self._state = _j_deactivate(
             self._state, np.int32(slot), np.int32(read_index)
         )
@@ -1497,11 +1674,21 @@ class JaxScorer(WavefrontScorer):
         npad = _next_pow2(len(pairs))
         hs = [self._slot_of[h] for h, _ in pairs]
         ridx = [r for _, r in pairs]
+        self._act_host[hs, ridx] = False
         hs += [hs[0]] * (npad - len(pairs))
         ridx += [ridx[0]] * (npad - len(pairs))
         self._state = _j_deactivate_batch(
             self._state, np.asarray([hs, ridx], dtype=np.int32)
         )
+
+    def _uniform_off(self, slot: int) -> Tuple[bool, int]:
+        """(is_uniform, off0) for a slot's ACTIVE reads, from the host
+        mirrors — decides the run kernels' dynamic-slice fast path."""
+        offs = self._off_host[slot][self._act_host[slot]]
+        if offs.size == 0:
+            return True, 0
+        off0 = int(offs[0])
+        return bool((offs == off0).all()), off0
 
     def run_extend(
         self,
@@ -1523,6 +1710,7 @@ class JaxScorer(WavefrontScorer):
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
+        uniform, off0 = self._uniform_off(slot)
         params = np.asarray(
             [
                 slot,
@@ -1532,12 +1720,13 @@ class JaxScorer(WavefrontScorer):
                 min_count,
                 int(l2),
                 max_steps,
+                off0,
             ],
             dtype=np.int32,
         )
         state, steps, code, stats, cons_row = _j_run(
-            self._state, self._reads, self._rlen, params,
-            self._wc, self._et, self._A,
+            self._state, self._reads, self._reads_pad, self._rlen, params,
+            self._wc, self._et, self._A, uniform,
         )
         self._state = state
         steps, code, stats_np, cons_np = jax.device_get(
@@ -1583,6 +1772,8 @@ class JaxScorer(WavefrontScorer):
         need = max(len(consensus1), len(consensus2)) + max_steps + 2
         while need >= self._C:
             self._grow_cons()
+        uni1, off0a = self._uniform_off(s1)
+        uni2, off0b = self._uniform_off(s2)
         params = np.asarray(
             [
                 s1,
@@ -1596,13 +1787,15 @@ class JaxScorer(WavefrontScorer):
                 int(l2),
                 int(weighted),
                 max_steps,
+                off0a,
+                off0b,
             ],
             dtype=np.int32,
         )
         state, steps, code, stats1, stats2, act1, act2, consa, consb = (
             _j_run_dual(
-                self._state, self._reads, self._rlen, params,
-                self._wc, self._et, self._A,
+                self._state, self._reads, self._reads_pad, self._rlen,
+                params, self._wc, self._et, self._A, uni1 and uni2,
             )
         )
         self._state = state
@@ -1625,6 +1818,11 @@ class JaxScorer(WavefrontScorer):
 
         app1 = appended(consa_np, consensus1)
         app2 = appended(consb_np, consensus2)
+        # divergence pruning deactivates lanes on device; keep the host
+        # act mirror exact or _uniform_off goes stale and silently drops
+        # the dynamic-slice fast path for this branch and its clones
+        self._act_host[s1] = act1_np
+        self._act_host[s2] = act2_np
         if code == 5:
             self._grow_e()
         n = self.num_reads
@@ -1677,19 +1875,31 @@ class JaxScorer(WavefrontScorer):
             raise ValueError("arena takes 1..ARENA_K nodes")
         kinds = []
         slots = []
+        live_sides = []
         self._scratch_reset()
         for h1, h2, _l1, _l2 in node_specs:
             kinds.append(1 if h2 is not None else 0)
+            live_sides.append(len(slots))
             slots.append(self._slot_of[h1])
-            slots.append(
-                self._slot_of[h2] if h2 is not None else self._scratch_slot()
-            )
+            if h2 is not None:
+                live_sides.append(len(slots))
+                slots.append(self._slot_of[h2])
+            else:
+                slots.append(self._scratch_slot())
         for _ in range(K - n_live):
             kinds.append(-1)
             slots.append(self._scratch_slot())
             slots.append(self._scratch_slot())
         if len(set(slots)) != 2 * K:
             raise ValueError("arena requires distinct state slots")
+        # dynamic-slice window path: every LIVE side's active reads must
+        # share one offset (scratch sides are garbage either way)
+        off0s = np.zeros(2 * K, dtype=np.int32)
+        uniform = True
+        for side in live_sides:
+            uni, off0 = self._uniform_off(slots[side])
+            uniform = uniform and uni
+            off0s[side] = off0
         step_limit = min(step_limit, self.ARENA_CAP)
         max_len = max(max(s[2], s[3]) for s in node_specs)
         while max_len + step_limit + 2 >= self._C:
@@ -1716,11 +1926,13 @@ class JaxScorer(WavefrontScorer):
             _j_arena(
                 self._state,
                 self._reads,
+                self._reads_pad,
                 self._rlen,
                 params,
                 np.asarray(slots, dtype=np.int32),
                 np.asarray(kinds, dtype=np.int32),
                 seqv0,
+                off0s,
                 np.asarray(tr_scalars, dtype=np.int32),
                 np.ascontiguousarray(lc, dtype=np.int32),
                 np.ascontiguousarray(pc, dtype=np.int32),
@@ -1729,6 +1941,7 @@ class JaxScorer(WavefrontScorer):
                 self._A,
                 self.ARENA_CAP,
                 K,
+                uniform,
             )
         )
         self._state = state
@@ -1745,6 +1958,9 @@ class JaxScorer(WavefrontScorer):
         )
         key = f"arena_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
+        # arena divergence pruning deactivates lanes on device; mirror it
+        for side in live_sides:
+            self._act_host[slots[side]] = act_np[side]
 
         appended = []
         sides_stats = []

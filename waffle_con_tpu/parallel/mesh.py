@@ -97,6 +97,9 @@ def shard_scorer(scorer, mesh: Mesh, read_axis: str = "read") -> None:
         "cons": NamedSharding(mesh, P(None, None)),
         "clen": NamedSharding(mesh, P(None)),
     }
+    #: the padded-reads copy (dynamic-slice window path) shards like reads;
+    #: keyed off-dict so the scorer's state re-placement loop ignores it
+    shardings["_reads_pad"] = NamedSharding(mesh, P(read_axis, None))
     scorer._shardings = shardings  # re-applied by the scorer after growth
     scorer._state = {
         name: jax.device_put(arr, shardings[name])
@@ -104,6 +107,9 @@ def shard_scorer(scorer, mesh: Mesh, read_axis: str = "read") -> None:
     }
     scorer._reads = jax.device_put(
         scorer._reads, NamedSharding(mesh, P(read_axis, None))
+    )
+    scorer._reads_pad = jax.device_put(
+        scorer._reads_pad, shardings["_reads_pad"]
     )
     scorer._rlen = jax.device_put(
         scorer._rlen, NamedSharding(mesh, P(read_axis))
