@@ -39,7 +39,7 @@ logger = logging.getLogger(__name__)
 
 #: Per-engagement column cap for the device run loops: bounds the host-side
 #: bookkeeping simulation; long clean stretches simply re-engage next pop.
-RUN_SIM_CAP = 4096
+RUN_SIM_CAP = 65536
 
 
 class EngineError(Exception):
@@ -387,7 +387,9 @@ class ConsensusDWFA:
             # farthest frontier without replaying a step the real search
             # would have pruned.
             run_extend = getattr(scorer, "run_extend", None)
-            if run_extend is not None:
+            reached_now = self._reached_end(node, cfg.allow_early_termination)
+            force_sym = -1
+            if run_extend is not None and not reached_now:
                 passing_now = (
                     node.prefetch[0]
                     if node.prefetch is not None
@@ -417,8 +419,26 @@ class ConsensusDWFA:
                 if best_other is not None:
                     other_cost = -best_other[0]
                     other_len = best_other[1]
+                # -- forced-child fold: with exactly one passing symbol
+                # and no prefetched children, the expand path's outcome
+                # is fully known host-side (one child = consensus + sym,
+                # created unconditionally), so the run call pushes it as
+                # its forced step 0 — replacing the separate clone+push
+                # dispatches — and simply stops there if the child would
+                # lose the next pop (the kernel re-queues it, exactly
+                # like the expand path's queue insert).  A near-tie vote
+                # that would stop an unforced run at step 0 commits the
+                # identical symbol here: the host's f64 nomination IS
+                # the ground truth the kernel's EPS contract defers to.
+                if len(passing_now) == 1 and node.prefetch is None:
+                    force_sym = int(
+                        scorer.sym_id[passing_now[0]]
+                        if hasattr(scorer, "sym_id")
+                        else -1
+                    )
                 engage = len(passing_now) == 1 and (
-                    top_cost < other_cost
+                    force_sym >= 0
+                    or top_cost < other_cost
                     or (top_cost == other_cost and top_len > other_len)
                 )
             else:
@@ -454,7 +474,13 @@ class ConsensusDWFA:
                         cfg.min_count,
                         cost is ConsensusCost.L2_DISTANCE,
                         max_steps,
+                        first_sym=force_sym,
                     )
+                    # the snapshot matches the stopped position whether
+                    # or not steps committed (steps == 0 leaves state
+                    # as-is), so adopt it either way — its fin field
+                    # saves the finalize dispatch at a reached-end pop
+                    node.stats = run_stats
                     if steps > 0:
                         # the branch advanced past the prefetched children
                         self._drop_prefetch(scorer, node)
@@ -470,7 +496,6 @@ class ConsensusDWFA:
                         )
                         nodes_explored += steps
                         node.consensus = node.consensus + appended
-                        node.stats = run_stats
                         if not pqueue.push(
                             node.key(), node, node.priority(cost)
                         ):  # pragma: no cover - chain nodes are unique
@@ -491,7 +516,11 @@ class ConsensusDWFA:
                     raise EngineError(
                         "Finalize called on DWFA that was never initialized."
                     )
-                fin_eds = scorer.finalized_eds(node.handle, node.consensus)
+                fin_eds = (
+                    node.stats.fin
+                    if node.stats.fin is not None
+                    else scorer.finalized_eds(node.handle, node.consensus)
+                )
                 fin_scores = [cost.apply(int(e)) for e in fin_eds]
                 fin_total = sum(fin_scores)
                 if fin_total < maximum_error:
@@ -510,7 +539,9 @@ class ConsensusDWFA:
                     for n, _p in pqueue.peek_top(cfg.prefetch_width - 1)
                     if n.prefetch is None
                 ]
-                self._prefetch_expansions(scorer, [node] + peers)
+                self._prefetch_expansions(
+                    scorer, [node] + peers, in_place_first=True
+                )
             passing, expansion = node.prefetch
             node.prefetch = None
 
@@ -536,7 +567,8 @@ class ConsensusDWFA:
                             stats,
                         )
                     )
-                scorer.free(node.handle)
+                if all(c.handle != node.handle for c in new_nodes):
+                    scorer.free(node.handle)
 
             for child in new_nodes:
                 activate_list = activate_points.get(len(child.consensus))
@@ -698,25 +730,39 @@ class ConsensusDWFA:
         )
 
     def _prefetch_expansions(
-        self, scorer: WavefrontScorer, nodes: List[_Node]
+        self,
+        scorer: WavefrontScorer,
+        nodes: List[_Node],
+        in_place_first: bool = False,
     ) -> None:
         """Expand every listed node's children in one fused clone dispatch
-        plus one fused push dispatch, storing the results on the nodes."""
+        plus one fused push dispatch, storing the results on the nodes.
+
+        ``in_place_first``: when the FIRST node has exactly one passing
+        symbol, push its sole child onto the parent's own branch slot
+        instead of a clone — exact because the parent is the in-hand pop,
+        consumed and freed in this same iteration (never valid for peers,
+        whose pristine state is still needed at their own pop)."""
         per_node_passing = []
         clone_srcs: List[int] = []
-        for node in nodes:
+        for i, node in enumerate(nodes):
             passing = self._nominate(scorer, node)
             per_node_passing.append(passing)
-            clone_srcs.extend([node.handle] * len(passing))
+            if not (in_place_first and i == 0 and len(passing) == 1):
+                clone_srcs.extend([node.handle] * len(passing))
         handles = scorer.clone_many(clone_srcs)
         push_specs: List[Tuple[int, bytes]] = []
         slots: List[List] = []
         hi = 0
-        for node, passing in zip(nodes, per_node_passing):
+        for i, (node, passing) in enumerate(zip(nodes, per_node_passing)):
             expansion = {}
+            reuse = in_place_first and i == 0 and len(passing) == 1
             for sym in passing:
-                handle = handles[hi]
-                hi += 1
+                if reuse:
+                    handle = node.handle
+                else:
+                    handle = handles[hi]
+                    hi += 1
                 entry = [handle, None]
                 expansion[sym] = entry
                 push_specs.append((handle, node.consensus + bytes([sym])))

@@ -221,18 +221,33 @@ def _stats_core_u(
     )
 
 
+def _finalized(e, rmin, act, E):
+    """Finalized per-read distances (reference ``finalize`` semantics:
+    ``max(e, rmin)``) plus the out-of-band flag — the ONE copy shared by
+    ``_j_finalize`` and the bundled-``fin`` fast paths."""
+    fin = jnp.maximum(e, rmin)
+    ovf = (act & (fin >= E)).any()
+    return jnp.where(act, jnp.minimum(fin, INF), 0), ovf
+
+
 # ======================================================================
 # whole-state jitted entry points.  state = dict of arrays; all donate the
 # state buffers (every overflowing op masks its commit, so the returned
 # state is unchanged when the host must re-bucket and retry).
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _j_root(state, rlen, h, act):
+@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
+def _j_root(state, reads, rlen, h, act, num_symbols):
+    """Root a branch at the empty consensus; also returns the root's
+    stats snapshot (the engines request it immediately, so bundling it
+    here saves the separate stats dispatch+fetch)."""
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     off = jnp.zeros_like(state["off"][h])
     D, e, rmin, er = _init_col(off, act, rlen, E, W)
+    stats = _stats_core(
+        D, e, rmin, er, off, act, rlen, reads, jnp.int32(0), num_symbols, E
+    )
     out = dict(state)
     out["D"] = state["D"].at[h].set(D)
     out["e"] = state["e"].at[h].set(e)
@@ -241,7 +256,7 @@ def _j_root(state, rlen, h, act):
     out["off"] = state["off"].at[h].set(0)
     out["act"] = state["act"].at[h].set(act)
     out["clen"] = state["clen"].at[h].set(0)
-    return out
+    return out, stats
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -277,6 +292,31 @@ def _j_deactivate_batch(state, hs_ridx):
     out = dict(state)
     out["act"] = state["act"].at[hs_ridx[0], hs_ridx[1]].set(False)
     return out
+
+
+@partial(jax.jit, static_argnames=("B", "R", "W", "C"))
+def _j_blank(B: int, R: int, W: int, C: int):
+    """Blank branch store built ON DEVICE: one fused dispatch instead of
+    a multi-MB host upload through the transfer tunnel."""
+    return {
+        "D": jnp.full((B, R, W), INF, jnp.int32),
+        "e": jnp.zeros((B, R), jnp.int32),
+        "rmin": jnp.full((B, R), INF, jnp.int32),
+        "er": jnp.full((B, R), INF, jnp.int32),
+        "off": jnp.zeros((B, R), jnp.int32),
+        "act": jnp.zeros((B, R), bool),
+        "cons": jnp.zeros((B, C), jnp.int32),
+        "clen": jnp.zeros((B,), jnp.int32),
+    }
+
+
+@partial(jax.jit, static_argnames=("W",))
+def _j_mkpad(reads, W: int):
+    """W-left/right-padded reads copy, built on device from the staged
+    reads array (saves re-uploading a second multi-MB array)."""
+    R = reads.shape[0]
+    fill = jnp.full((R, W), -1, reads.dtype)
+    return jnp.concatenate([fill, reads, fill], axis=1)
 
 
 @partial(jax.jit, static_argnames=("new_b",))
@@ -321,7 +361,8 @@ def _j_push_batch(state, reads, rlen, hs_syms, wc, et, num_symbols):
         stats = _stats_core(
             Dn, en, rminn, ern, off, act, rlen, reads, jnew, num_symbols, E
         )
-        return Dn, en, rminn, ern, ovf, stats
+        fin, fin_ovf = _finalized(en, rminn, act, E)
+        return Dn, en, rminn, ern, ovf, stats + (fin, ~fin_ovf)
 
     Dn, en, rminn, ern, ovfs, stats = jax.vmap(one)(
         state["D"][hs],
@@ -427,10 +468,7 @@ def _j_finalize(state, h):
     baseline end).  Non-mutating."""
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
-    act = state["act"][h]
-    fin = jnp.maximum(state["e"][h], state["rmin"][h])
-    overflow = (act & (fin >= E)).any()
-    return jnp.where(act, jnp.minimum(fin, INF), 0), overflow
+    return _finalized(state["e"][h], state["rmin"][h], state["act"][h], E)
 
 
 @partial(
@@ -461,12 +499,27 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     3 = node would lose the next pop (budget/priority), 4 = step limit,
     5 = band overflow (last push not committed).
 
+    ``params[8]`` is an optional FORCED first symbol (or -1): the host
+    has already nominated this node's unique passing child exactly (the
+    device f32 fold was too close to call, or the host simply knows the
+    expansion), so step 0 pushes it without vote or pop-priority checks
+    — the child exists either way; if it then loses the next pop the
+    loop stops and the host re-queues it, bit-identical to the expand
+    path but without the separate clone+push dispatches.  Band overflow
+    on the forced push returns (steps=0, code=5) uncommitted.
+
+    The returned ``fin_eds``/``fin_ovf`` mirror ``_j_finalize`` at the
+    stopped position, so a reached-end stop needs no follow-up finalize
+    dispatch (``fin_ovf`` falls back to the real finalize after band
+    growth).
+
     This is the TPU answer to the reference's symbol-at-a-time host loop:
     for clean stretches the consensus grows entirely on device, with one
     host round-trip per *event* instead of per base.
 
-    ``params`` is ``[7] int32`` — (slot, me_budget, other_cost, other_len,
-    min_count, l2, max_steps) — packed into a single host upload.
+    ``params`` is ``[9] int32`` — (slot, me_budget, other_cost, other_len,
+    min_count, l2, max_steps, off0, first_sym) — packed into a single
+    host upload.
     """
     h = params[0]
     me_budget = params[1]
@@ -590,20 +643,42 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         steps = steps + commit.astype(steps.dtype)
         return D, e, rmin, er, cons, clen, steps, code
 
-    init = (
-        state["D"][h],
-        state["e"][h],
-        state["rmin"][h],
-        state["er"][h],
-        state["cons"][h],
-        state["clen"][h],
-        jnp.int32(0),
-        jnp.int32(0),
-    )
+    D0 = state["D"][h]
+    e0 = state["e"][h]
+    rmin0 = state["rmin"][h]
+    er0 = state["er"][h]
+    cons0 = state["cons"][h]
+    clen0 = state["clen"][h]
+
+    # forced first push (host-nominated child), vote/priority checks
+    # bypassed; only band overflow can refuse it.  Under lax.cond the
+    # unforced common case skips the extra column step entirely.
+    first_sym = params[8]
+
+    def forced(_):
+        Df, ef, rminf, erf = col_at(D0, e0, rmin0, er0, clen0 + 1, first_sym)
+        fovf = (act & (ef >= E)).any()
+        sel0 = lambda new, old: jnp.where(~fovf, new, old)  # noqa: E731
+        return (
+            sel0(Df, D0),
+            sel0(ef, e0),
+            sel0(rminf, rmin0),
+            sel0(erf, er0),
+            sel0(cons0.at[jnp.clip(clen0, 0, C - 1)].set(first_sym), cons0),
+            sel0(clen0 + 1, clen0),
+            (~fovf).astype(jnp.int32),
+            jnp.where(fovf, 5, 0).astype(jnp.int32),
+        )
+
+    def unforced(_):
+        return (D0, e0, rmin0, er0, cons0, clen0, jnp.int32(0), jnp.int32(0))
+
+    init = lax.cond(first_sym >= 0, forced, unforced, None)
     D, e, rmin, er, cons, clen, steps, code = lax.while_loop(
         lambda c: c[7] == 0, body, init
     )
     stats = stats_at(D, e, rmin, er, clen)
+    fin_eds, fin_ovf = _finalized(e, rmin, act, E)
     out = dict(state)
     out["D"] = state["D"].at[h].set(D)
     out["e"] = state["e"].at[h].set(e)
@@ -611,7 +686,7 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     out["er"] = state["er"].at[h].set(er)
     out["cons"] = state["cons"].at[h].set(cons)
     out["clen"] = state["clen"].at[h].set(clen)
-    return out, steps, code, stats, cons
+    return out, steps, code, stats, cons, fin_eds, fin_ovf
 
 
 def _dual_votes(occ, split, w, wc, weighted):
@@ -1400,12 +1475,14 @@ class JaxScorer(WavefrontScorer):
         self._L = max(_next_pow2(max(max_len, 1)), self.MIN_L)
         self._A = max(_next_pow2(max(self.num_symbols, 1)), self.MIN_A)
 
-        reads_arr = np.full((self._R, self._L), -1, dtype=np.int32)
+        # int16 symbol storage: dense ids are < 257 and the -1 sentinel
+        # fits, while the dominant ctor upload through the transfer
+        # tunnel halves vs int32 (kernel arithmetic promotes as needed)
+        reads_arr = np.full((self._R, self._L), -1, dtype=np.int16)
         rlen = np.zeros(self._R, dtype=np.int32)
         for i, r in enumerate(self.reads):
             reads_arr[i, : len(r)] = [self.sym_id[b] for b in r]
             rlen[i] = len(r)
-        self._reads_host = reads_arr
         self._reads = jax.device_put(reads_arr)
         self._rlen = jax.device_put(rlen)
 
@@ -1466,34 +1543,18 @@ class JaxScorer(WavefrontScorer):
         return 2 * self._E + 2
 
     def _blank_state(self):
-        # built host-side and transferred in one device_put (a jnp.full /
-        # jnp.zeros here would each dispatch a tiny compiled fill op)
-        host = {
-            "D": np.full((self._B, self._R, self._W), INF, dtype=np.int32),
-            "e": np.zeros((self._B, self._R), dtype=np.int32),
-            "rmin": np.full((self._B, self._R), INF, dtype=np.int32),
-            "er": np.full((self._B, self._R), INF, dtype=np.int32),
-            "off": np.zeros((self._B, self._R), dtype=np.int32),
-            "act": np.zeros((self._B, self._R), dtype=bool),
-            "cons": np.zeros((self._B, self._C), dtype=np.int32),
-            "clen": np.zeros((self._B,), dtype=np.int32),
-        }
-        return jax.device_put(host)
+        return _j_blank(self._B, self._R, self._W, self._C)
 
     def _stage_reads_pad(self) -> None:
         """Stage the W-left-padded reads copy backing the run kernels'
         ``dynamic_slice`` window path (rebuilt on band growth: the pad
         width is the band width).  ``-1`` filler never matches a symbol
         or the wildcard, and every out-of-range lane is masked anyway."""
-        W = self._W
-        pad = np.full((self._R, self._L + 2 * W), -1, dtype=np.int32)
-        pad[:, W : W + self._L] = self._reads_host
+        self._reads_pad = _j_mkpad(self._reads, W=self._W)
         if self._shardings is not None and "_reads_pad" in self._shardings:
             self._reads_pad = jax.device_put(
-                pad, self._shardings["_reads_pad"]
+                self._reads_pad, self._shardings["_reads_pad"]
             )
-        else:
-            self._reads_pad = jax.device_put(pad)
 
     def _place(self) -> None:
         """Re-apply the mesh sharding (if any) after a geometry change —
@@ -1552,7 +1613,13 @@ class JaxScorer(WavefrontScorer):
         handle, slot = self._alloc()
         act = np.zeros(self._R, dtype=bool)
         act[: len(active)] = active
-        self._state = _j_root(self._state, self._rlen, np.int32(slot), act)
+        self._state, stats = _j_root(
+            self._state, self._reads, self._rlen, np.int32(slot), act,
+            self._A,
+        )
+        #: un-fetched device stats; consumed by the engine's immediate
+        #: ``stats()`` call without a second dispatch
+        self._root_stats = (handle, stats)
         self._off_host[slot] = 0
         self._act_host[slot] = act
         return handle
@@ -1591,6 +1658,13 @@ class JaxScorer(WavefrontScorer):
         if slot is not None:
             self._free.append(slot)
 
+    def _invalidate_root_stats(self) -> None:
+        """The bundled root snapshot is only valid while the branch is
+        untouched; any state evolution drops it (the engines consume it
+        immediately after ``root``, so this never costs a re-dispatch in
+        practice)."""
+        self._root_stats = None
+
     def push(self, h: int, consensus: bytes) -> BranchStats:
         return self.push_many([(h, consensus)])[0]
 
@@ -1601,6 +1675,7 @@ class JaxScorer(WavefrontScorer):
         appended symbol (vmapped over branch slots)."""
         if not specs:
             return []
+        self._invalidate_root_stats()
         self.counters["push_calls"] += 1
         self.counters["push_branches"] += len(specs)
         for _, consensus in specs:
@@ -1631,6 +1706,10 @@ class JaxScorer(WavefrontScorer):
             return self._stats_rows(stats_np, n)
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
+        cached = getattr(self, "_root_stats", None)
+        if cached is not None and cached[0] == h:
+            self._root_stats = None
+            return self._stats_np(jax.device_get(cached[1]))
         self.counters["stats_calls"] += 1
         slot = self._slot_of[h]
         return self._stats_np(
@@ -1645,6 +1724,7 @@ class JaxScorer(WavefrontScorer):
     def activate(
         self, h: int, read_index: int, offset: int, consensus: bytes
     ) -> None:
+        self._invalidate_root_stats()
         self.counters["activate_calls"] += 1
         slot = self._slot_of[h]
         self._off_host[slot, read_index] = offset
@@ -1662,6 +1742,7 @@ class JaxScorer(WavefrontScorer):
             return
 
     def deactivate(self, h: int, read_index: int) -> None:
+        self._invalidate_root_stats()
         slot = self._slot_of[h]
         self._act_host[slot, read_index] = False
         self._state = _j_deactivate(
@@ -1671,6 +1752,7 @@ class JaxScorer(WavefrontScorer):
     def deactivate_many(self, pairs) -> None:
         if not pairs:
             return
+        self._invalidate_root_stats()
         npad = _next_pow2(len(pairs))
         hs = [self._slot_of[h] for h, _ in pairs]
         ridx = [r for _, r in pairs]
@@ -1700,13 +1782,18 @@ class JaxScorer(WavefrontScorer):
         min_count: int,
         l2: bool,
         max_steps: int,
+        first_sym: int = -1,
     ) -> Tuple[int, int, bytes, BranchStats]:
         """Device-side unambiguous-run extension; returns
         ``(steps_committed, stop_code, appended_bytes, stats)`` with
-        ``stats`` the branch snapshot at the stopped position (saving the
-        follow-up ``stats`` dispatch).  See ``_j_run`` for the stop-code
-        contract; on overflow the band is grown so the caller can simply
-        continue stepping."""
+        ``stats`` the branch snapshot at the stopped position, its
+        ``fin`` field carrying the finalized per-read distances there
+        (``None`` when the band cannot express them) — both saving their
+        own follow-up dispatches.  ``first_sym`` (a dense id, or -1)
+        force-pushes the host's already-nominated unique child as step 0.
+        See ``_j_run`` for the stop-code contract; on overflow the band
+        is grown so the caller can simply continue stepping."""
+        self._invalidate_root_stats()
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
@@ -1721,16 +1808,17 @@ class JaxScorer(WavefrontScorer):
                 int(l2),
                 max_steps,
                 off0,
+                first_sym,
             ],
             dtype=np.int32,
         )
-        state, steps, code, stats, cons_row = _j_run(
+        state, steps, code, stats, cons_row, fin_eds, fin_ovf = _j_run(
             self._state, self._reads, self._reads_pad, self._rlen, params,
             self._wc, self._et, self._A, uniform,
         )
         self._state = state
-        steps, code, stats_np, cons_np = jax.device_get(
-            (steps, code, stats, cons_row)
+        steps, code, stats_np, cons_np, fin_np, fin_ovf = jax.device_get(
+            (steps, code, stats, cons_row, fin_eds, fin_ovf)
         )
         steps = int(steps)
         code = int(code)
@@ -1744,7 +1832,9 @@ class JaxScorer(WavefrontScorer):
             appended = self.symtab[ids].astype(np.uint8).tobytes()
         if code == 5:
             self._grow_e()
-        return steps, code, appended, self._stats_np(stats_np)
+        return steps, code, appended, self._stats_np(
+            stats_np + (fin_np, np.logical_not(fin_ovf))
+        )
 
     def run_extend_dual(
         self,
@@ -1767,6 +1857,7 @@ class JaxScorer(WavefrontScorer):
         appended1, appended2, stats1, stats2, active1, active2)``.  See
         ``_j_run_dual`` for the stop-code contract.  Caller preconditions:
         neither side locked, ``min_af == 0``."""
+        self._invalidate_root_stats()
         s1 = self._slot_of[h1]
         s2 = self._slot_of[h2]
         need = max(len(consensus1), len(consensus2)) + max_steps + 2
@@ -1869,6 +1960,7 @@ class JaxScorer(WavefrontScorer):
         per_side_appended, per_side_stats, per_side_act)`` with sides
         flattened as ``[n0s1, n0s2, n1s1, ...]`` (side-2 entries of
         single nodes and all entries of padding nodes are None)."""
+        self._invalidate_root_stats()
         K = self.ARENA_K
         n_live = len(node_specs)
         if not 1 <= n_live <= K:
@@ -2034,20 +2126,24 @@ class JaxScorer(WavefrontScorer):
         """Host-array stats -> :class:`BranchStats`, slicing read padding
         and alphabet padding away.  Input must already be numpy (ONE
         ``jax.device_get`` per scorer call — per-element indexing of live
-        device arrays would dispatch a tiny gather op each time)."""
-        eds, occ, split, reached = stats_np
+        device arrays would dispatch a tiny gather op each time).  A
+        6-tuple carries bundled finalized distances (+validity)."""
+        eds, occ, split, reached = stats_np[:4]
         n = self.num_reads
         a = self.num_symbols
+        fin = None
+        if len(stats_np) == 6 and bool(stats_np[5]):
+            fin = stats_np[4][:n].astype(np.int64)
         return BranchStats(
             eds[:n].astype(np.int64),
             occ[:n, :a].astype(np.int64),
             split[:n].astype(np.int64),
             reached[:n],
+            fin,
         )
 
     def _stats_rows(self, stats_np, count: int) -> List[BranchStats]:
-        eds, occ, split, reached = stats_np
         return [
-            self._stats_np((eds[i], occ[i], split[i], reached[i]))
+            self._stats_np(tuple(part[i] for part in stats_np))
             for i in range(count)
         ]

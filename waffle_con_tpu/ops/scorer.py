@@ -47,15 +47,19 @@ class BranchStats:
     * ``split`` — ``[R] int64`` total tips per read (vote normalizer).
     * ``reached`` — ``[R] bool`` whether the read's wavefront has touched
       the end of its baseline (False if untracked).
+    * ``fin`` — optional ``[R] int64`` finalized distances at this
+      position, bundled by scorers whose snapshot dispatch can compute
+      them for free (``None`` when unknown or out of band).
     """
 
-    __slots__ = ("eds", "occ", "split", "reached")
+    __slots__ = ("eds", "occ", "split", "reached", "fin")
 
-    def __init__(self, eds, occ, split, reached):
+    def __init__(self, eds, occ, split, reached, fin=None):
         self.eds = eds
         self.occ = occ
         self.split = split
         self.reached = reached
+        self.fin = fin
 
 
 def build_symbol_table(reads: Sequence[bytes], wildcard: Optional[int]) -> np.ndarray:
@@ -308,6 +312,7 @@ class SubsetScorer(WavefrontScorer):
             stats.occ[idx],
             stats.split[idx],
             stats.reached[idx],
+            stats.fin[idx] if stats.fin is not None else None,
         )
 
     # -- branch lifecycle ----------------------------------------------
