@@ -268,7 +268,8 @@ def bench_single(num_reads, seq_len, error_rate, trace=None):
         counters.get(k, 0)
         for k in (
             "push_calls", "run_calls", "stats_calls", "clone_calls",
-            "activate_calls", "finalize_calls",
+            "clone_push_calls", "activate_calls", "finalize_calls",
+            "arena_calls", "run_dual_calls",
         )
     )
     return {

@@ -431,11 +431,7 @@ class ConsensusDWFA:
                 # identical symbol here: the host's f64 nomination IS
                 # the ground truth the kernel's EPS contract defers to.
                 if len(passing_now) == 1 and node.prefetch is None:
-                    force_sym = int(
-                        scorer.sym_id[passing_now[0]]
-                        if hasattr(scorer, "sym_id")
-                        else -1
-                    )
+                    force_sym = int(scorer.sym_id[passing_now[0]])
                 engage = len(passing_now) == 1 and (
                     force_sym >= 0
                     or top_cost < other_cost
@@ -510,7 +506,9 @@ class ConsensusDWFA:
 
             # -- result check: any (or, with early termination, all) read
             # touching its baseline end means this consensus may be complete
-            if self._reached_end(node, cfg.allow_early_termination):
+            # (reached_now is current: every path that changed node.stats
+            # since it was computed has already `continue`d)
+            if reached_now:
                 if not all(node.active):
                     scorer.free(node.handle)
                     raise EngineError(
@@ -629,9 +627,6 @@ class ConsensusDWFA:
             next_act = min((l for l in activate_points if l > nl), default=None)
             if next_act is not None:
                 step_limit = min(step_limit, next_act - nl - 1)
-        step_limit = min(
-            step_limit, cfg.max_nodes_wo_constraint - last_constraint - 1
-        )
         if step_limit < 1:
             restore_all()
             return None
@@ -678,6 +673,7 @@ class ConsensusDWFA:
             cfg.max_queue_size,
             cfg.max_capacity_per_size,
             step_limit,
+            cfg.max_nodes_wo_constraint,
             np.stack([lc, zeros]),
             np.stack([pc, zeros]),
             np.asarray(tr_scalars, dtype=np.int32),
@@ -743,16 +739,35 @@ class ConsensusDWFA:
         instead of a clone — exact because the parent is the in-hand pop,
         consumed and freed in this same iteration (never valid for peers,
         whose pristine state is still needed at their own pop)."""
-        per_node_passing = []
+        per_node_passing = [self._nominate(scorer, n) for n in nodes]
+        clone_push = getattr(scorer, "clone_push_many", None)
+        if clone_push is not None:
+            specs: List[Tuple[int, bytes, bool]] = []
+            slots: List[List] = []
+            for i, (node, passing) in enumerate(
+                zip(nodes, per_node_passing)
+            ):
+                expansion = {}
+                reuse = in_place_first and i == 0 and len(passing) == 1
+                for sym in passing:
+                    entry = [None, None]
+                    expansion[sym] = entry
+                    specs.append(
+                        (node.handle, node.consensus + bytes([sym]), reuse)
+                    )
+                    slots.append(entry)
+                node.prefetch = (passing, expansion)
+            for entry, (handle, stats) in zip(slots, clone_push(specs)):
+                entry[0] = handle
+                entry[1] = stats
+            return
         clone_srcs: List[int] = []
-        for i, node in enumerate(nodes):
-            passing = self._nominate(scorer, node)
-            per_node_passing.append(passing)
+        for i, (node, passing) in enumerate(zip(nodes, per_node_passing)):
             if not (in_place_first and i == 0 and len(passing) == 1):
                 clone_srcs.extend([node.handle] * len(passing))
         handles = scorer.clone_many(clone_srcs)
         push_specs: List[Tuple[int, bytes]] = []
-        slots: List[List] = []
+        slots = []
         hi = 0
         for i, (node, passing) in enumerate(zip(nodes, per_node_passing)):
             expansion = {}

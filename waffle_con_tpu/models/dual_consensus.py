@@ -744,11 +744,6 @@ class DualConsensusDWFA:
             next_act = min((l for l in activate_points if l > nl), default=None)
             if next_act is not None:
                 step_limit = min(step_limit, next_act - nl - 1)
-        step_limit = min(
-            step_limit,
-            cfg.max_nodes_wo_constraint - single_last_constraint - 1,
-            cfg.max_nodes_wo_constraint - dual_last_constraint - 1,
-        )
         if step_limit < 1:
             restore_all()
             return None
@@ -807,6 +802,7 @@ class DualConsensusDWFA:
             cfg.max_queue_size,
             cfg.max_capacity_per_size,
             step_limit,
+            cfg.max_nodes_wo_constraint,
             np.stack([lc_s, lc_d]),
             np.stack([pc_s, pc_d]),
             np.asarray(tr_scalars, dtype=np.int32),
@@ -1115,31 +1111,61 @@ class DualConsensusDWFA:
         dispatch and ONE fused push dispatch across all of them, storing
         ``(specs, children)`` on each node's ``prefetch``."""
         per_node_specs = [self._build_specs(scorer, node) for node in nodes]
+        clone_push = getattr(scorer, "clone_push_many", None)
 
+        #: fused-path bookkeeping: (src_handle, consensus|None) per cloned
+        #: side, plus where to deliver the resulting (handle, stats)
+        fused_specs: List[Tuple[int, Optional[bytes], bool]] = []
+        fused_targets: List[Tuple[_DualNode, bool]] = []
+        #: legacy-path bookkeeping
         clone_srcs: List[int] = []
-        for node, specs in zip(nodes, per_node_specs):
-            for kind, _a, _b in specs:
-                if kind == "dual":
-                    clone_srcs += [node.h1, node.h2]
-                elif kind == "single":
-                    clone_srcs += [node.h1]
-                else:  # split: both sides start from consensus1's state
-                    clone_srcs += [node.h1, node.h1]
-        handles = scorer.clone_many(clone_srcs)
-
         push_specs: List[Tuple[int, bytes]] = []
         push_targets: List[Tuple[_DualNode, bool]] = []
+
+        def check_lock(child: _DualNode, side1: bool) -> None:
+            if side1 and child.lock1:
+                raise EngineError("Consensus 1 is locked, cannot modify")
+            if not side1 and child.lock2:
+                raise EngineError("Consensus 2 is locked, cannot modify")
+
+        def fused_side(child, src_handle, sym, side1) -> None:
+            """Register one cloned side: push ``sym`` onto it (None =
+            clone only); handle+stats assigned after the fused call."""
+            if sym is not None:
+                check_lock(child, side1)
+                if side1:
+                    child.consensus1 = child.consensus1 + bytes([sym])
+                else:
+                    child.consensus2 = child.consensus2 + bytes([sym])
+            fused_specs.append(
+                (
+                    src_handle,
+                    (child.consensus1 if side1 else child.consensus2)
+                    if sym is not None
+                    else None,
+                    False,
+                )
+            )
+            fused_targets.append((child, side1))
+
+        if clone_push is None:
+            for node, specs in zip(nodes, per_node_specs):
+                for kind, _a, _b in specs:
+                    if kind == "dual":
+                        clone_srcs += [node.h1, node.h2]
+                    elif kind == "single":
+                        clone_srcs += [node.h1]
+                    else:  # split: both sides start from consensus1
+                        clone_srcs += [node.h1, node.h1]
+            handles = scorer.clone_many(clone_srcs)
         hi = 0
 
         def queue_push(child: _DualNode, sym: int, side1: bool) -> None:
+            check_lock(child, side1)
             if side1:
-                if child.lock1:
-                    raise EngineError("Consensus 1 is locked, cannot modify")
                 child.consensus1 = child.consensus1 + bytes([sym])
                 push_specs.append((child.h1, child.consensus1))
             else:
-                if child.lock2:
-                    raise EngineError("Consensus 2 is locked, cannot modify")
                 child.consensus2 = child.consensus2 + bytes([sym])
                 push_specs.append((child.h2, child.consensus2))
             push_targets.append((child, side1))
@@ -1156,48 +1182,76 @@ class DualConsensusDWFA:
                     child.is_dual = True
                     child.lock1 = node.lock1
                     child.lock2 = node.lock2
-                    child.h1, child.h2 = handles[hi], handles[hi + 1]
-                    hi += 2
                     child.consensus2 = node.consensus2
                     child.active2 = list(node.active2)
                     child.offsets2 = list(node.offsets2)
                     child.stats2 = node.stats2
-                    if a is not None:
-                        queue_push(child, a, True)
+                    if clone_push is not None:
+                        fused_side(child, node.h1, a, True)
+                        fused_side(child, node.h2, b, False)
+                        if a is None:
+                            child.lock1 = True
+                        if b is None:
+                            child.lock2 = True
                     else:
-                        child.lock1 = True
-                    if b is not None:
-                        queue_push(child, b, False)
-                    else:
-                        child.lock2 = True
+                        child.h1, child.h2 = handles[hi], handles[hi + 1]
+                        hi += 2
+                        if a is not None:
+                            queue_push(child, a, True)
+                        else:
+                            child.lock1 = True
+                        if b is not None:
+                            queue_push(child, b, False)
+                        else:
+                            child.lock2 = True
                 elif kind == "single":
-                    child.h1 = handles[hi]
-                    hi += 1
                     child.consensus2 = node.consensus2
                     child.active2 = list(node.active2)
                     child.offsets2 = list(node.offsets2)
-                    queue_push(child, a, True)
+                    if clone_push is not None:
+                        fused_side(child, node.h1, a, True)
+                    else:
+                        child.h1 = handles[hi]
+                        hi += 1
+                        queue_push(child, a, True)
                 else:  # split (/root/reference/src/dual_consensus.rs:957-976)
                     check_invariant(a != b, "dual split needs distinct symbols")
                     child.is_dual = True
-                    child.h1, child.h2 = handles[hi], handles[hi + 1]
-                    hi += 2
                     child.consensus2 = node.consensus1
                     child.active2 = list(node.active1)
                     child.offsets2 = list(node.offsets1)
                     child.stats2 = node.stats1
-                    queue_push(child, a, True)
-                    queue_push(child, b, False)
+                    if clone_push is not None:
+                        fused_side(child, node.h1, a, True)
+                        fused_side(child, node.h1, b, False)
+                    else:
+                        child.h1, child.h2 = handles[hi], handles[hi + 1]
+                        hi += 2
+                        queue_push(child, a, True)
+                        queue_push(child, b, False)
                 children.append(child)
             node.prefetch = (specs, children)
 
-        for (child, side1), stats in zip(
-            push_targets, scorer.push_many(push_specs)
-        ):
-            if side1:
-                child.stats1 = stats
-            else:
-                child.stats2 = stats
+        if clone_push is not None:
+            for (child, side1), (handle, stats) in zip(
+                fused_targets, clone_push(fused_specs)
+            ):
+                if side1:
+                    child.h1 = handle
+                    if stats is not None:
+                        child.stats1 = stats
+                else:
+                    child.h2 = handle
+                    if stats is not None:
+                        child.stats2 = stats
+        else:
+            for (child, side1), stats in zip(
+                push_targets, scorer.push_many(push_specs)
+            ):
+                if side1:
+                    child.stats1 = stats
+                else:
+                    child.stats2 = stats
 
     def _expand(
         self,

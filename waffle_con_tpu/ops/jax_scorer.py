@@ -394,6 +394,77 @@ def _j_push_batch(state, reads, rlen, hs_syms, wc, et, num_symbols):
     return out, stats, overflow
 
 
+@partial(jax.jit, static_argnames=("num_symbols",), donate_argnums=(0,))
+def _j_clone_push_batch(state, reads, rlen, rows, wc, et, num_symbols):
+    """Fused expansion: clone each ``src`` row into ``dst`` and advance
+    the copy by one symbol, in ONE dispatch (``rows`` is ``[3, npad]
+    int32`` — src slot, dst slot, symbol; symbol ``-1`` = clone only,
+    ``src == dst`` = in-place push).  Replaces the engines' separate
+    clone_many + push_many round trips — on the tunneled TPU each
+    dispatch costs ~65-90ms, which dwarfs the fused kernel's work.
+    Returns (state, per-branch stats incl. bundled fin, overflow);
+    commits nothing on overflow (so in-place sources stay pristine for
+    the host's grow-and-retry)."""
+    srcs = rows[0]
+    dsts = rows[1]
+    syms = rows[2]
+    W = state["D"].shape[2]
+    E = jnp.int32((W - 2) // 2)
+    C = state["cons"].shape[1]
+
+    def one(D, e, rmin, er, off, act, cons, clen, sym):
+        push = sym >= 0
+        jnew = clen + 1
+        Dn, en, rminn, ern = _col_step(
+            D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
+        )
+        sel = lambda new, old: jnp.where(push, new, old)  # noqa: E731
+        Dn = sel(Dn, D)
+        en = sel(en, e)
+        rminn = sel(rminn, rmin)
+        ern = sel(ern, er)
+        consn = sel(cons.at[jnp.clip(clen, 0, C - 1)].set(sym), cons)
+        clenn = sel(clen + 1, clen)
+        ovf = push & (act & (en >= E)).any()
+        stats = _stats_core(
+            Dn, en, rminn, ern, off, act, rlen, reads, clenn, num_symbols, E
+        )
+        fin, fin_ovf = _finalized(en, rminn, act, E)
+        return (
+            Dn, en, rminn, ern, off, act, consn, clenn, ovf,
+            stats + (fin, ~fin_ovf),
+        )
+
+    (Dn, en, rminn, ern, offn, actn, consn, clenn, ovfs, stats) = jax.vmap(
+        one
+    )(
+        state["D"][srcs],
+        state["e"][srcs],
+        state["rmin"][srcs],
+        state["er"][srcs],
+        state["off"][srcs],
+        state["act"][srcs],
+        state["cons"][srcs],
+        state["clen"][srcs],
+        syms,
+    )
+    overflow = ovfs.any()
+    out = dict(state)
+
+    def commit(new, name):
+        return jnp.where(overflow, state[name][dsts], new)
+
+    out["D"] = state["D"].at[dsts].set(commit(Dn, "D"))
+    out["e"] = state["e"].at[dsts].set(commit(en, "e"))
+    out["rmin"] = state["rmin"].at[dsts].set(commit(rminn, "rmin"))
+    out["er"] = state["er"].at[dsts].set(commit(ern, "er"))
+    out["off"] = state["off"].at[dsts].set(commit(offn, "off"))
+    out["act"] = state["act"].at[dsts].set(commit(actn, "act"))
+    out["cons"] = state["cons"].at[dsts].set(commit(consn, "cons"))
+    out["clen"] = state["clen"].at[dsts].set(commit(clenn, "clen"))
+    return out, stats, overflow
+
+
 @partial(jax.jit, static_argnames=("num_symbols",))
 def _j_stats(state, reads, rlen, h, num_symbols):
     W = state["D"].shape[2]
@@ -1003,13 +1074,13 @@ def _j_arena(
     original queue insertion order for FIFO tie-breaks; re-pushed nodes
     take fresh, larger ranks and lose full ties to never-popped entries.
 
-    ``params`` is ``[12] int32``: (me_budget, min_count, ed_delta,
+    ``params`` is ``[13] int32``: (me_budget, min_count, ed_delta,
     imb_min, l2, weighted, rest_cost, rest_len, n_live, max_queue_size,
-    capacity_per_size, step_limit).  ``tr_scalars`` is ``[2, 4] int32``:
-    per kind (threshold, total, farthest, last_constraint).  The
-    ``max_nodes_wo_constraint`` constriction trigger cannot fire on
-    device: the host bounds ``step_limit`` below both kinds' remaining
-    budgets.
+    capacity_per_size, step_limit, max_nodes_wo_constraint).
+    ``tr_scalars`` is ``[2, 4] int32``: per kind (threshold, total,
+    farthest, last_constraint).  Both host constriction triggers are
+    modeled on device (queue overflow and the ``max_nodes_wo_constraint``
+    budget), so the host does NOT need to clamp ``step_limit``.
 
     Stop codes: 1 = winner needs host arbitration (votes/finished side),
     2 = winner reached its baseline end (host records the result),
@@ -1031,6 +1102,7 @@ def _j_arena(
     max_queue = params[9]
     cap = params[10]
     step_limit = params[11]
+    max_nwc = params[12]
 
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
@@ -1213,18 +1285,17 @@ def _j_arena(
         # constricted and removed by the engine before the arena engaged.
         def constrict_kind(k_, tr_):
             def body_(args):
-                thr_, total_ = args
+                thr_, total_, _lcon = args
                 total_ = total_ - lc[k_, jnp.clip(thr_, 0, Lw - 1)]
-                return thr_ + 1, total_
+                return thr_ + 1, total_, jnp.int32(0)
 
-            thr_, total_ = lax.while_loop(
+            thr_, total_, lcon_ = lax.while_loop(
                 lambda a: ~first
-                & (a[1] > max_queue)
+                & ((a[1] > max_queue) | (a[2] >= max_nwc))
                 & (a[0] < tr_[k_, 2]),
                 body_,
-                (tr_[k_, 0], tr_[k_, 1]),
+                (tr_[k_, 0], tr_[k_, 1], tr_[k_, 3]),
             )
-            lcon_ = jnp.where(thr_ != tr_[k_, 0], 0, tr_[k_, 3])
             return tr_.at[k_, 0].set(thr_).at[k_, 1].set(total_).at[
                 k_, 3
             ].set(lcon_)
@@ -1705,6 +1776,65 @@ class JaxScorer(WavefrontScorer):
                 continue
             return self._stats_rows(stats_np, n)
 
+    def clone_push_many(self, specs):
+        """Fused expansion (see ``_j_clone_push_batch``): ``specs`` is a
+        list of ``(src_handle, consensus_or_None, in_place)`` — clone
+        ``src`` (or reuse its slot when ``in_place``) and, when a
+        consensus is given, advance the copy by its last symbol.
+        Returns ``[(handle, stats_or_None), ...]`` in spec order."""
+        if not specs:
+            return []
+        self._invalidate_root_stats()
+        self.counters["clone_push_calls"] = (
+            self.counters.get("clone_push_calls", 0) + 1
+        )
+        for _src, consensus, _inp in specs:
+            if consensus is not None:
+                while len(consensus) >= self._C - 1:
+                    self._grow_cons()
+        n = len(specs)
+        srcs = []
+        dsts = []
+        syms = []
+        handles = []
+        for src_h, consensus, in_place in specs:
+            src = self._slot_of[src_h]
+            if in_place:
+                handles.append(src_h)
+                dst = src
+            else:
+                handle, dst = self._alloc()
+                handles.append(handle)
+            srcs.append(src)
+            dsts.append(dst)
+            syms.append(
+                -1 if consensus is None else self.sym_id[consensus[-1]]
+            )
+            self._off_host[dst] = self._off_host[src]
+            self._act_host[dst] = self._act_host[src]
+        if len(set(dsts)) != n:
+            raise ValueError("clone_push_many: duplicate destination slots")
+        npad = _next_pow2(n)
+        srcs += [srcs[0]] * (npad - n)
+        dsts += [dsts[0]] * (npad - n)
+        syms += [syms[0]] * (npad - n)
+        rows = np.asarray([srcs, dsts, syms], dtype=np.int32)
+        while True:
+            state, stats, overflow = _j_clone_push_batch(
+                self._state, self._reads, self._rlen, rows,
+                self._wc, self._et, self._A,
+            )
+            self._state = state
+            stats_np, ovf = jax.device_get((stats, overflow))
+            if bool(ovf):
+                self._grow_e()
+                continue
+            rows_out = self._stats_rows(stats_np, n)
+            return [
+                (h, rows_out[i] if specs[i][1] is not None else None)
+                for i, h in enumerate(handles)
+            ]
+
     def stats(self, h: int, consensus: bytes) -> BranchStats:
         cached = getattr(self, "_root_stats", None)
         if cached is not None and cached[0] == h:
@@ -1930,11 +2060,19 @@ class JaxScorer(WavefrontScorer):
 
     #: fixed history capacity of the arena kernel (static shape: one
     #: compiled kernel per geometry, dynamic step_limit rides in params)
-    ARENA_CAP = 512
+    #: ceiling for the arena history; the effective per-scorer cap
+    #: (``ARENA_CAP`` property) scales with read length so small
+    #: fixtures keep small compiled windows while 10kb workloads get
+    #: long uninterrupted arena stretches
+    ARENA_CAP_MAX = 2048
+
+    @property
+    def ARENA_CAP(self) -> int:
+        return min(self.ARENA_CAP_MAX, max(512, _next_pow2(self._L // 2)))
     #: node capacity of the arena kernel (static; dead-node padding).
     #: Sized for the live-chain count of tie-heavy dual searches; per-
     #: iteration compute scales with K but stays tiny for a TPU VPU
-    ARENA_K = 8
+    ARENA_K = 32
 
     def run_arena(
         self,
@@ -1950,6 +2088,7 @@ class JaxScorer(WavefrontScorer):
         max_queue_size: int,
         capacity_per_size: int,
         step_limit: int,
+        max_nodes_wo_constraint: int,
         lc: np.ndarray,    # [2, Lw] per-kind queue length counts
         pc: np.ndarray,    # [2, Lw] per-kind processed counts
         tr_scalars: np.ndarray,  # [2, 4] (thr, total, farthest, last_constr)
@@ -2010,6 +2149,7 @@ class JaxScorer(WavefrontScorer):
                 max_queue_size,
                 capacity_per_size,
                 step_limit,
+                max_nodes_wo_constraint,
             ],
             dtype=np.int32,
         )

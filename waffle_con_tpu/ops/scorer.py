@@ -292,6 +292,8 @@ class SubsetScorer(WavefrontScorer):
             self.run_extend_dual = None  # type: ignore[assignment]
         if not hasattr(base, "run_arena"):
             self.run_arena = None  # type: ignore[assignment]
+        if not hasattr(base, "clone_push_many"):
+            self.clone_push_many = None  # type: ignore[assignment]
 
     @property
     def ARENA_CAP(self):
@@ -341,6 +343,12 @@ class SubsetScorer(WavefrontScorer):
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
         return self._slice(self.base.stats(h, consensus))
+
+    def clone_push_many(self, specs):
+        return [
+            (h, self._slice(s) if s is not None else None)
+            for h, s in self.base.clone_push_many(specs)
+        ]
 
     def activate(
         self, h: int, read_index: int, offset: int, consensus: bytes
