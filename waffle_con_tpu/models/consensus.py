@@ -149,9 +149,15 @@ def replay_arena_history(hist, lens, kinds, trackers, far, lcon, cfg, on_length=
     already constricted and removed before the arena engaged).
 
     ``lens``/``far``/``lcon`` are mutated in place (``lens`` per node;
-    ``far``/``lcon`` per kind, matching ``trackers``)."""
+    ``far``/``lcon`` per kind, matching ``trackers``).  A negative
+    history entry ``-(node + 1)`` is an on-device DISCARDED pop: its
+    queue removal is replayed but nothing else (the engine's
+    ignored-pop path — no process/insert/farthest/constraint)."""
     for i, which in enumerate(hist):
         which = int(which)
+        disc = which < 0
+        if disc:
+            which = -which - 1
         k = kinds[which]
         length = lens[which]
         if i > 0:
@@ -163,6 +169,8 @@ def replay_arena_history(hist, lens, kinds, trackers, far, lcon, cfg, on_length=
                     trackers[kk].increment_threshold()
                     lcon[kk] = 0
             trackers[k].remove(length)
+        if disc:
+            continue
         far[k] = max(far[k], length)
         lcon[k] += 1
         trackers[k].process(length)
@@ -172,20 +180,28 @@ def replay_arena_history(hist, lens, kinds, trackers, far, lcon, cfg, on_length=
         lens[which] += 1
 
 
-def requeue_arena_nodes(pqueue, nodes, taken, node_steps, hist, cost, on_duplicate):
+def requeue_arena_nodes(
+    pqueue, nodes, taken, node_steps, hist, cost, on_duplicate, alive=None
+):
     """Re-queue arena participants preserving insertion order: extended
     nodes re-enter in the order of their LAST arena pop (later pop ->
     newer insertion seq); never-popped competitors keep their original
     seq (FIFO tie order).  ``on_duplicate(idx, node)`` handles the rare
-    key collision (drop the newcomer, undo its replayed tracker insert)."""
+    key collision (drop the newcomer, undo its replayed tracker insert).
+    Nodes discarded on device (``alive[idx]`` False) are never re-queued
+    — the caller frees them."""
     last_pop = {}
     for i, which in enumerate(hist):
-        last_pop[int(which)] = i
+        which = int(which)
+        if which >= 0:
+            last_pop[which] = i
     for i, (cand, pri, seq) in enumerate(taken, start=1):
-        if node_steps[i] == 0:
+        if node_steps[i] == 0 and (alive is None or alive[i]):
             ok = pqueue.push_restored(cand.key(), cand, pri, seq)
             check_invariant(ok, "arena restore unique")
     for idx in sorted(last_pop, key=last_pop.get):
+        if alive is not None and not alive[idx]:
+            continue
         nd = nodes[idx]
         if not pqueue.push(nd.key(), nd, nd.priority(cost)):
             on_duplicate(idx, nd)
@@ -408,10 +424,10 @@ class ConsensusDWFA:
                         farthest_consensus, last_constraint,
                     )
                     if arena is not None:
-                        farthest_consensus, last_constraint, arena_steps = (
-                            arena
-                        )
-                        nodes_explored += arena_steps
+                        (farthest_consensus, last_constraint, arena_steps,
+                         arena_ignored) = arena
+                        nodes_explored += arena_steps - arena_ignored
+                        nodes_ignored += arena_ignored
                         continue
                 best_other = pqueue.peek_priority()
                 other_cost = 2**31 - 1
@@ -660,7 +676,7 @@ class ConsensusDWFA:
             int(maximum_error) if maximum_error != math.inf else 2**31 - 1
         )
         (hist, nsteps, _code, _stop_node, node_steps, appended,
-         sides_stats, _sides_act) = scorer.run_arena(
+         sides_stats, _sides_act, alive) = scorer.run_arena(
             [(nd.handle, None, len(nd.consensus), 0) for nd in nodes],
             me_budget,
             cfg.min_count,
@@ -683,7 +699,7 @@ class ConsensusDWFA:
             return None
 
         for i, nd in enumerate(nodes):
-            if node_steps[i] > 0:
+            if node_steps[i] > 0 or not alive[i]:
                 self._drop_prefetch(scorer, nd)
 
         # exact tracker replay of the committed interleaved pop sequence
@@ -695,7 +711,7 @@ class ConsensusDWFA:
         )
 
         for i, nd in enumerate(nodes):
-            if node_steps[i] == 0:
+            if node_steps[i] == 0 or not alive[i]:
                 continue
             nd.consensus = nd.consensus + appended[2 * i]
             nd.stats = sides_stats[2 * i]
@@ -708,9 +724,15 @@ class ConsensusDWFA:
             scorer.free(nd.handle)
 
         requeue_arena_nodes(
-            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate
+            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate,
+            alive=alive,
         )
-        return far[0], lcon[0], int(nsteps)
+        n_discarded = 0
+        for i, nd in enumerate(nodes):
+            if not alive[i]:
+                scorer.free(nd.handle)
+                n_discarded += 1
+        return far[0], lcon[0], int(nsteps), n_discarded
 
     def _nominate(self, scorer: WavefrontScorer, node: _Node) -> List[int]:
         """Passing extension symbols for a node — a pure function of its

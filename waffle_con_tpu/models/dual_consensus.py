@@ -473,8 +473,9 @@ class DualConsensusDWFA:
                 if arena is not None:
                     (farthest_single, farthest_dual,
                      single_last_constraint, dual_last_constraint,
-                     arena_steps) = arena
-                    nodes_explored += arena_steps
+                     arena_steps, arena_ignored) = arena
+                    nodes_explored += arena_steps - arena_ignored
+                    nodes_ignored += arena_ignored
                     continue
             if runnable:
                 best_other = pqueue.peek_priority()
@@ -781,7 +782,7 @@ class DualConsensusDWFA:
             int(maximum_error) if maximum_error != math.inf else 2**31 - 1
         )
         (hist, nsteps, _code, _stop_node, node_steps, appended,
-         sides_stats, sides_act) = scorer.run_arena(
+         sides_stats, sides_act, alive) = scorer.run_arena(
             [
                 (
                     nd.h1,
@@ -812,7 +813,7 @@ class DualConsensusDWFA:
             return None
 
         for i, nd in enumerate(nodes):
-            if node_steps[i] > 0:
+            if node_steps[i] > 0 or not alive[i]:
                 self._drop_prefetch(scorer, nd)
 
         # exact tracker replay of the committed interleaved pop sequence
@@ -832,17 +833,19 @@ class DualConsensusDWFA:
             ),
         )
         # kind-split step attribution for the engagement metrics
-        arena_dual = sum(1 for w in hist if kinds[int(w)] == 1)
+        # (discarded pops are negative entries; count committed only)
+        committed = sum(1 for w in hist if int(w) >= 0)
+        arena_dual = sum(1 for w in hist if int(w) >= 0 and kinds[int(w)] == 1)
         scorer.counters["arena_dual_steps"] = (
             scorer.counters.get("arena_dual_steps", 0) + arena_dual
         )
         scorer.counters["arena_single_steps"] = (
             scorer.counters.get("arena_single_steps", 0)
-            + (int(nsteps) - arena_dual)
+            + (committed - arena_dual)
         )
 
         for i, nd in enumerate(nodes):
-            if node_steps[i] == 0:
+            if node_steps[i] == 0 or not alive[i]:
                 continue
             s1, s2 = 2 * i, 2 * i + 1
             nd.consensus1 = nd.consensus1 + appended[s1]
@@ -872,9 +875,15 @@ class DualConsensusDWFA:
             self._free_node(scorer, nd)
 
         requeue_arena_nodes(
-            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate
+            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate,
+            alive=alive,
         )
-        return far[0], far[1], lcon[0], lcon[1], int(nsteps)
+        n_discarded = 0
+        for i, nd in enumerate(nodes):
+            if not alive[i]:
+                self._free_node(scorer, nd)
+                n_discarded += 1
+        return far[0], far[1], lcon[0], lcon[1], int(nsteps), n_discarded
 
     # ==================================================================
     # node helpers

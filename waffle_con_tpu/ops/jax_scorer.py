@@ -1084,11 +1084,14 @@ def _j_arena(
 
     Stop codes: 1 = winner needs host arbitration (votes/finished side),
     2 = winner reached its baseline end (host records the result),
-    3 = a rest-of-queue entry wins the pop, 4 = step limit, 5 = band
-    overflow, 7 = winner would be DISCARDED at its pop (me-budget,
-    threshold, capacity, or dual imbalance) — the host pop performs the
-    discard.  Returns (state, hist, n_steps, code, stop_node,
-    per-node steps, per-side stats, act, cons, clen).
+    3 = a rest-of-queue entry wins the pop (or every arena node died),
+    4 = step limit, 5 = band overflow.  A winner that would be
+    DISCARDED at its pop (me-budget, threshold, capacity, or dual
+    imbalance) is discarded ON DEVICE — queue removal applied, the node
+    marked dead, history records ``K + node`` — and the loop continues
+    with the survivors (the host frees dead nodes and replays their
+    removals).  Returns (state, hist, n_steps, code, stop_node,
+    per-node steps, per-side stats, act, cons, clen, alive).
     """
     me_budget = params[0]
     min_count = params[1]
@@ -1233,7 +1236,7 @@ def _j_arena(
 
     def body(carry):
         (D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
-         nsteps, seqv, fresh, seq_ctr, _code, _stop_node) = carry
+         nsteps, seqv, fresh, alive, seq_ctr, _code, _stop_node) = carry
 
         eds, occ, split, reached = stats_all(D, e, rmin, er, act, clen)
 
@@ -1247,7 +1250,7 @@ def _j_arena(
             reached.reshape(K, 2, R),
             clen.reshape(K, 2),
         )
-        totals = jnp.where(live, totals, BIGTOT)
+        totals = jnp.where(live & alive, totals, BIGTOT)
 
         # ---- pop-winner tournament: host priority is (-cost, len) with
         # FIFO (smaller seq rank) on full ties
@@ -1270,6 +1273,9 @@ def _j_arena(
         win = jnp.where(first, 0, win)
         wtot = totals[win]
         wlen = lens[win]
+        # every arena node dead (all discarded): the host resumes from
+        # the outer queue — same exit as a rest-of-queue win
+        arena_empty = wtot == BIGTOT
         # vs the best rest-of-queue entry: rest wins cost ties at equal
         # length unless the winner's ORIGINAL queue entry (never
         # re-pushed) predates it
@@ -1315,12 +1321,21 @@ def _j_arena(
             | imb[win]
         )
 
+        # a discarded pop is handled ON DEVICE (the host pre-checked the
+        # in-hand first pop, so `first` discards cannot occur): the node
+        # dies, its queue entry is removed, and the loop continues with
+        # the survivors — the host replays the removal from the history.
+        # With the history full the arena stops 4 instead and the host
+        # performs the discard at its own re-pop.
+        discard_now = ~rest_wins & ~arena_empty & discarded & (
+            nsteps < step_limit
+        )
         code = jnp.where(
-            rest_wins,
+            rest_wins | arena_empty,
             3,
             jnp.where(
                 discarded,
-                7,
+                jnp.where(nsteps >= step_limit, 4, 0),
                 jnp.where(
                     reach[win],
                     2,
@@ -1355,8 +1370,12 @@ def _j_arena(
         act1n = act[s1] & ~(both2 & (e2n + delta < e1n))
         act2n = act[s2] & ~(both2 & (e1n + delta < e2n))
 
-        commit = (code == 0) & ~ovf
-        code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
+        commit = (code == 0) & ~discard_now & ~ovf
+        code = jnp.where(
+            code != 0,
+            code,
+            jnp.where(discard_now, 0, jnp.where(ovf, 5, 0)),
+        )
 
         D = D.at[s1].set(jnp.where(commit, D1n, D[s1]))
         e = e.at[s1].set(jnp.where(commit, e1n, e[s1]))
@@ -1403,30 +1422,38 @@ def _j_arena(
         far2 = jnp.maximum(far, wlen)
         lcon2 = lcon + 1
 
-        lc = jnp.where(commit, lc.at[k].set(lc_k), lc)
+        # discard bookkeeping: the pop's queue removal only (no process /
+        # insert / farthest / lcon — the engine's ignored-pop path)
+        lc_disc = lc.at[k, li].add(-1)
+        tr_disc = tr.at[k, 1].set(total_q - (wlen >= thr).astype(jnp.int32))
+
+        lc = jnp.where(
+            commit, lc.at[k].set(lc_k), jnp.where(discard_now, lc_disc, lc)
+        )
         pc = jnp.where(commit, pc.at[k].set(pc_k), pc)
         tr = jnp.where(
             commit,
             tr.at[k].set(jnp.stack([thr, total_q2, far2, lcon2])),
-            tr,
+            jnp.where(discard_now, tr_disc, tr),
         )
 
+        recorded = commit | discard_now
+        hist_val = jnp.where(discard_now, win + K, win).astype(jnp.int8)
         hist = jnp.where(
-            commit,
-            hist.at[jnp.clip(nsteps, 0, max_steps - 1)].set(
-                win.astype(jnp.int8)
-            ),
+            recorded,
+            hist.at[jnp.clip(nsteps, 0, max_steps - 1)].set(hist_val),
             hist,
         )
         steps = jnp.where(commit, steps.at[win].add(1), steps)
-        nsteps = nsteps + commit.astype(jnp.int32)
+        alive = jnp.where(discard_now, alive.at[win].set(False), alive)
+        nsteps = nsteps + recorded.astype(jnp.int32)
         seqv = jnp.where(commit, seqv.at[win].set(seq_ctr), seqv)
         fresh = jnp.where(commit, fresh.at[win].set(False), fresh)
         seq_ctr = seq_ctr + commit.astype(jnp.int32)
         stop_node = win
         return (
             D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
-            nsteps, seqv, fresh, seq_ctr, code, stop_node,
+            nsteps, seqv, fresh, alive, seq_ctr, code, stop_node,
         )
 
     init = (
@@ -1445,13 +1472,14 @@ def _j_arena(
         jnp.int32(0),
         seqv0,
         jnp.arange(K) != 0,  # node 0's original entry is the in-hand pop
+        jnp.ones((K,), bool),  # alive: cleared by on-device discards
         jnp.int32(K + 1),
         jnp.int32(0),
         jnp.int32(0),
     )
     (D, e, rmin, er, act, cons, clen, _lc, _pc, _tr, steps, hist,
-     nsteps, _seqv, _fresh, _ctr, code, stop_node) = lax.while_loop(
-        lambda c: c[16] == 0, body, init
+     nsteps, _seqv, _fresh, alive, _ctr, code, stop_node) = lax.while_loop(
+        lambda c: c[17] == 0, body, init
     )
 
     eds, occ, split, reached = stats_all(D, e, rmin, er, act, clen)
@@ -1466,7 +1494,7 @@ def _j_arena(
     out["clen"] = state["clen"].at[slots].set(clen)
     return (
         out, hist, nsteps, code, stop_node, steps,
-        (eds, occ, split, reached), act, cons, clen,
+        (eds, occ, split, reached), act, cons, clen, alive,
     )
 
 
@@ -2096,9 +2124,13 @@ class JaxScorer(WavefrontScorer):
         """K-node pop arena (see ``_j_arena``); node 0 must be the
         engine's in-hand pop, later nodes in their queue pop order.
         Returns ``(hist, nsteps, code, stop_node, per_node_steps,
-        per_side_appended, per_side_stats, per_side_act)`` with sides
-        flattened as ``[n0s1, n0s2, n1s1, ...]`` (side-2 entries of
-        single nodes and all entries of padding nodes are None)."""
+        per_side_appended, per_side_stats, per_side_act, alive)`` with
+        sides flattened as ``[n0s1, n0s2, n1s1, ...]`` (side-2 entries
+        of single nodes and all entries of padding nodes are None).
+        ``hist`` entries are node indices for committed pops and
+        ``-(node + 1)`` for on-device discarded pops; ``alive[node]`` is
+        False when the node died mid-arena (caller frees it and must
+        not re-queue it)."""
         self._invalidate_root_stats()
         K = self.ARENA_K
         n_live = len(node_specs)
@@ -2154,7 +2186,8 @@ class JaxScorer(WavefrontScorer):
             dtype=np.int32,
         )
         seqv0 = np.arange(K, dtype=np.int32)
-        state, hist, nsteps, code, stop_node, steps, stats, act, cons, clen = (
+        (state, hist, nsteps, code, stop_node, steps, stats, act, cons,
+         clen, alive) = (
             _j_arena(
                 self._state,
                 self._reads,
@@ -2178,18 +2211,26 @@ class JaxScorer(WavefrontScorer):
         )
         self._state = state
         (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
-         cons_np) = jax.device_get(
-            (hist, nsteps, code, stop_node, steps, stats, act, cons)
+         cons_np, alive_np) = jax.device_get(
+            (hist, nsteps, code, stop_node, steps, stats, act, cons, alive)
         )
         nsteps = int(nsteps)
         code = int(code)
         stop_node = int(stop_node)
+        # committed pops keep their node index; discards become -(n+1)
+        hist_np = hist_np.astype(np.int32)
+        hist_np = np.where(hist_np >= K, -(hist_np - K) - 1, hist_np)
         self.counters["arena_calls"] = self.counters.get("arena_calls", 0) + 1
         self.counters["arena_steps"] = (
             self.counters.get("arena_steps", 0) + nsteps
         )
         key = f"arena_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
+        n_disc = int(np.count_nonzero(~alive_np[:n_live]))
+        if n_disc:
+            self.counters["arena_discards"] = (
+                self.counters.get("arena_discards", 0) + n_disc
+            )
         # arena divergence pruning deactivates lanes on device; mirror it
         for side in live_sides:
             self._act_host[slots[side]] = act_np[side]
@@ -2231,6 +2272,7 @@ class JaxScorer(WavefrontScorer):
             appended,
             sides_stats,
             sides_act,
+            [bool(a) for a in alive_np],
         )
 
     def _scratch_reset(self) -> None:

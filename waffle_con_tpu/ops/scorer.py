@@ -393,7 +393,9 @@ class SubsetScorer(WavefrontScorer):
 
     def run_arena(self, *args, **kwargs):
         (hist, nsteps, code, stop_node, node_steps, appended,
-         sides_stats, sides_act) = self.base.run_arena(*args, **kwargs)
+         sides_stats, sides_act, alive) = self.base.run_arena(
+            *args, **kwargs
+        )
         idx = self.indices
         sides_stats = [
             self._slice(s) if s is not None else None for s in sides_stats
@@ -401,7 +403,7 @@ class SubsetScorer(WavefrontScorer):
         sides_act = [a[idx] if a is not None else None for a in sides_act]
         return (
             hist, nsteps, code, stop_node, node_steps, appended,
-            sides_stats, sides_act,
+            sides_stats, sides_act, alive,
         )
 
 
