@@ -376,7 +376,14 @@ def bench_dual(num_reads, seq_len, error_rate):
             "run_steps": counters.get("run_steps", 0),
             "arena_calls": counters.get("arena_calls", 0),
             "arena_steps": counters.get("arena_steps", 0),
+            "arena_discards": counters.get("arena_discards", 0),
+            "arena_stops": {
+                k[-1]: v
+                for k, v in sorted(counters.items())
+                if k.startswith("arena_stop_")
+            },
             "push_calls": counters.get("push_calls", 0),
+            "clone_push_calls": counters.get("clone_push_calls", 0),
             "grow_events": counters.get("grow_e_events", 0),
             "dual_engagement": round(
                 (
@@ -594,7 +601,7 @@ def _north_star_orchestrated(args) -> None:
     dual_scale = (
         ["--dual"]
         if gate_platform == "device"
-        else ["--dual", "--reads", "32", "--len", "2500"]
+        else ["--dual", "--reads", "16", "--len", "1500"]
     )
     for mode, label, budget_need in (
         (dual_scale, "dual", 300),

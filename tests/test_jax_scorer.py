@@ -304,3 +304,99 @@ def test_push_many_duplicate_handle_guard():
     h = jx.root(np.array([True, True]))
     with pytest.raises(ValueError, match="duplicate branch handles"):
         jx.push_many([(h, b"A"), (h, b"C")])
+
+
+def test_clone_push_many_matches_clone_then_push():
+    """The fused clone+push dispatch must be bit-identical to the
+    separate clone_many + push_many sequence, including clone-only and
+    in-place entries."""
+    rng = np.random.default_rng(11)
+    reads = [bytes(rng.integers(0, 4, size=30)) for _ in range(5)]
+    config = CdwfaConfig()
+    jx = JaxScorer(reads, config)
+    base = bytes(reads[0][:6])
+    h = jx.root(np.ones(5, dtype=bool))
+    for i in range(1, len(base) + 1):
+        jx.push(h, base[:i])
+
+    # reference: separate clone + push
+    ref_handles = jx.clone_many([h, h])
+    ref_stats = jx.push_many(
+        [(ref_handles[0], base + bytes([0])), (ref_handles[1], base + bytes([1]))]
+    )
+
+    # fused: two pushed clones, one clone-only, one in-place push on a
+    # throwaway clone of h
+    inp = jx.clone(h)
+    out = jx.clone_push_many(
+        [
+            (h, base + bytes([0]), False),
+            (h, base + bytes([1]), False),
+            (h, None, False),
+            (inp, base + bytes([2]), True),
+        ]
+    )
+    assert out[2][1] is None  # clone-only: no stats
+    assert out[3][0] == inp  # in-place reuses the handle
+    for k in range(2):
+        assert_stats_equal(ref_stats[k], out[k][1], f"fused[{k}]")
+    # the clone-only copy and the source are indistinguishable
+    assert_stats_equal(
+        jx.stats(h, base), jx.stats(out[2][0], base), "clone-only"
+    )
+    # in-place pushed state equals a fresh clone pushed the same way
+    ref2 = jx.clone(h)
+    ref2_stats = jx.push(ref2, base + bytes([2]))
+    assert_stats_equal(ref2_stats, out[3][1], "in-place")
+
+
+def test_run_extend_forced_first_symbol():
+    """A forced first symbol commits without vote checks and matches the
+    unforced clone+push route; a node that would lose the next pop still
+    commits exactly the forced step."""
+    rng = np.random.default_rng(12)
+    reads = [bytes(rng.integers(0, 4, size=60)) for _ in range(4)]
+    config = CdwfaConfig(min_count=2)
+    jx = JaxScorer(reads, config)
+    h = jx.root(np.ones(4, dtype=bool))
+    st = jx.stats(h, b"")
+    # nominate host-side: the strongest next symbol
+    votes = (st.occ.astype(float) / np.maximum(st.split, 1)[:, None]).sum(0)
+    sym_dense = int(np.argmax(votes))
+    sym = int(jx.symtab[sym_dense])
+
+    ref = jx.clone(h)
+    ref_stats = jx.push(ref, bytes([sym]))
+
+    # losing node: other_cost 0 stops the run right after the forced step
+    steps, code, appended, stats = jx.run_extend(
+        h, b"", 2**31 - 1, 0, 0, 2, False, 64, first_sym=sym_dense
+    )
+    assert steps == 1
+    assert code == 3
+    assert appended == bytes([sym])
+    assert_stats_equal(ref_stats, stats, "forced")
+
+
+def test_run_and_push_bundle_finalized_distances():
+    """stats.fin from runs and pushes equals finalized_eds at the same
+    position."""
+    rng = np.random.default_rng(13)
+    reads = [bytes(rng.integers(0, 4, size=50)) for _ in range(4)]
+    config = CdwfaConfig(min_count=2)
+    jx = JaxScorer(reads, config)
+    h = jx.root(np.ones(4, dtype=bool))
+    steps, code, appended, stats = jx.run_extend(
+        h, b"", 2**31 - 1, 2**31 - 1, 0, 2, False, 500
+    )
+    assert steps > 0
+    if stats.fin is not None:
+        np.testing.assert_array_equal(
+            stats.fin, jx.finalized_eds(h, appended), "run fin"
+        )
+    child = jx.clone_push_many([(h, appended + bytes([0]), False)])
+    ch, cstats = child[0]
+    if cstats.fin is not None:
+        np.testing.assert_array_equal(
+            cstats.fin, jx.finalized_eds(ch, appended + bytes([0])), "push fin"
+        )

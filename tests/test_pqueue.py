@@ -2,6 +2,7 @@
 ``/root/reference/src/pqueue_tracker.rs:150-171``) and the set-semantics
 priority queue."""
 
+import numpy as np
 import pytest
 
 from waffle_con_tpu.utils.pqueue import (
@@ -72,3 +73,75 @@ def test_set_priority_queue_duplicate_rejected():
     item, _ = q.pop()
     assert item == 1
     assert q.is_empty()
+
+
+def test_replay_run_bookkeeping_fast_path_matches_scalar():
+    """The vectorized run-replay (bulk_run_advance segments) must leave
+    the tracker in exactly the state of the scalar per-step loop, across
+    constriction triggers, queue pressure, and capacity edges."""
+    import copy
+
+    from waffle_con_tpu.config import CdwfaConfig
+    from waffle_con_tpu.models.consensus import replay_run_bookkeeping
+
+    rng = np.random.default_rng(7)
+
+    def scalar_reference(tracker, cfg, top_len, steps, far, lcon):
+        for j in range(steps):
+            length = top_len + j
+            if j > 0:
+                while (
+                    len(tracker) > cfg.max_queue_size
+                    or lcon >= cfg.max_nodes_wo_constraint
+                ) and tracker.threshold() < far:
+                    tracker.increment_threshold()
+                    lcon = 0
+                tracker.remove(length)
+            far = max(far, length)
+            lcon += 1
+            tracker.process(length)
+            tracker.insert(length + 1)
+        return far, lcon
+
+    for trial in range(200):
+        cfg = CdwfaConfig(
+            max_queue_size=int(rng.integers(1, 6)),
+            max_capacity_per_size=int(rng.integers(1, 5)),
+            max_nodes_wo_constraint=int(rng.integers(2, 12)),
+        )
+        tr = PQueueTracker(64, cfg.max_capacity_per_size)
+        # random pre-existing queue population and processing history
+        for _ in range(int(rng.integers(0, 8))):
+            tr.insert(int(rng.integers(0, 20)))
+        for _ in range(int(rng.integers(0, 6))):
+            v = int(rng.integers(0, 10))
+            if not tr.at_capacity(v):
+                tr.process(v)
+        thr0 = int(rng.integers(0, 3))
+        tr.increase_threshold(thr0)
+        top_len = int(rng.integers(thr0, thr0 + 6))
+        tr.insert(top_len)
+        tr.remove(top_len)  # the in-hand pop
+        far = top_len + int(rng.integers(0, 4))
+        lcon = int(rng.integers(0, cfg.max_nodes_wo_constraint))
+        steps = int(rng.integers(1, 30))
+
+        ref = copy.deepcopy(tr)
+        try:
+            want_far, want_lcon = scalar_reference(
+                ref, cfg, top_len, steps, far, lcon
+            )
+        except CapacityFullError:
+            # engines bound steps so this cannot arise for them; the
+            # fast path must surface the same error
+            with pytest.raises(CapacityFullError):
+                replay_run_bookkeeping(tr, cfg, top_len, steps, far, lcon)
+            continue
+        got_far, got_lcon = replay_run_bookkeeping(
+            tr, cfg, top_len, steps, far, lcon
+        )
+        assert (got_far, got_lcon) == (want_far, want_lcon), trial
+        assert tr._length_counts == ref._length_counts, trial
+        assert tr._processed_counts == ref._processed_counts, trial
+        assert tr._total_count == ref._total_count, trial
+        assert tr.threshold() == ref.threshold(), trial

@@ -121,8 +121,42 @@ def replay_run_bookkeeping(
     is at the frontier (``top_len >= farthest``), so every replayed length
     beyond the first has never been processed, and the first is the pop's
     own process.
+
+    Fast path (no ``on_length``): segments between constriction triggers
+    collapse to one vectorized ``bulk_run_advance`` — the queue total is
+    constant during a run, so the only mid-run trigger is the
+    ``max_nodes_wo_constraint`` counter, whose firing step is computable
+    in closed form.
     """
-    for j in range(steps):
+    j = 0
+    while on_length is None and j < steps:
+        if j > 0:
+            # constrict exactly as the scalar loop would before pop j
+            while (
+                len(tracker) > cfg.max_queue_size
+                or last_constraint >= cfg.max_nodes_wo_constraint
+            ) and tracker.threshold() < farthest:
+                tracker.increment_threshold()
+                last_constraint = 0
+        # inside a segment the queue total transiently holds one extra
+        # entry (each step's insert precedes the next step's remove);
+        # if that would trip the queue-size trigger, every inner step
+        # would constrict and the closed form breaks — go scalar
+        if len(tracker) + 1 > cfg.max_queue_size:
+            break
+        seg = min(
+            steps - j, cfg.max_nodes_wo_constraint - last_constraint
+        )
+        if seg <= 0:
+            break  # budget pinned with threshold at farthest: go scalar
+        if not tracker.bulk_run_advance(
+            top_len + j, seg, fresh_pop=(j == 0)
+        ):
+            break  # capacity edge: exact scalar loop handles it
+        farthest = max(farthest, top_len + j + seg - 1)
+        last_constraint += seg
+        j += seg
+    for j in range(j, steps):
         length = top_len + j
         if j > 0:
             while (

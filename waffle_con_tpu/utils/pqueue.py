@@ -76,6 +76,38 @@ class PQueueTracker:
             return 0
         return self._processed_counts[value]
 
+    def bulk_run_advance(
+        self, start_len: int, steps: int, fresh_pop: bool = True
+    ) -> bool:
+        """Apply the net tracker effect of a constriction-free frontier
+        run segment: ``steps`` consecutive (pop at ``L``, process ``L``,
+        insert ``L+1``) cycles starting at ``start_len``, where every
+        intermediate insert is immediately consumed by the next pop.
+        ``fresh_pop`` False means the segment continues an earlier one,
+        so its first cycle pops (removes) the entry the previous
+        segment's final insert queued.  Returns False (and applies
+        nothing) if any touched length is at processing capacity — the
+        caller falls back to the exact scalar loop.  All lengths must be
+        at or above the threshold (true for any run: pops below the
+        threshold are discarded, not run)."""
+        if steps <= 0:
+            return True
+        end = start_len + steps  # exclusive of the final inserted length
+        if end >= len(self._processed_counts):
+            self._processed_counts.extend(
+                [0] * (end + 1 - len(self._processed_counts))
+            )
+        window = np.asarray(self._processed_counts[start_len:end])
+        if window.max(initial=0) >= self._capacity_per_size:
+            return False
+        self._processed_counts[start_len:end] = (window + 1).tolist()
+        if not fresh_pop:
+            self.remove(start_len)
+        # intermediate inserts at start_len+1 .. end-1 are each consumed
+        # by the following pop, so length_counts only nets the final one
+        self.insert(end)
+        return True
+
     def at_capacity(self, value: int) -> bool:
         return self.processed(value) >= self._capacity_per_size
 
