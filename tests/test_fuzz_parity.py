@@ -1,0 +1,141 @@
+"""Randomized differential parity fuzz: the jax-backend engines must
+produce byte-identical results (sequences, scores, assignments) to the
+Python oracle across randomized workload shapes — error rates, read
+counts, haplotype splits, offsets, wildcards, cost models, and
+min-counts chosen to exercise the device fast paths (runs, arenas,
+forced pushes, fused expansions, on-device discards) against their
+per-symbol oracle flow."""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.config import ConsensusCost
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+
+def _cfg(backend, rng, **over):
+    b = (
+        CdwfaConfigBuilder()
+        .backend(backend)
+        .min_count(over.get("min_count", int(rng.integers(1, 4))))
+    )
+    if over.get("l2"):
+        b = b.consensus_cost(ConsensusCost.L2_DISTANCE)
+    if over.get("weighted"):
+        b = b.weighted_by_ed(True)
+    if over.get("et"):
+        b = b.allow_early_termination(True)
+    return b.build()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_single_engine_fuzz(seed):
+    rng = np.random.default_rng(1000 + seed)
+    seq_len = int(rng.integers(40, 260))
+    n = int(rng.integers(4, 10))
+    er = float(rng.choice([0.0, 0.01, 0.03, 0.06]))
+    truth, reads = generate_test(4, seq_len, n, er, seed=2000 + seed)
+    over = {
+        "l2": bool(rng.integers(0, 2)),
+        "et": bool(rng.integers(0, 2)),
+        "min_count": int(rng.integers(1, max(2, n // 2))),
+    }
+    engines = []
+    for backend in ("python", "jax"):
+        e = ConsensusDWFA(_cfg(backend, np.random.default_rng(seed), **over))
+        for r in reads:
+            e.add_sequence(r)
+        engines.append(e)
+    want = engines[0].consensus()
+    got = engines[1].consensus()
+    assert [(c.sequence, c.scores) for c in want] == [
+        (c.sequence, c.scores) for c in got
+    ]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_dual_engine_fuzz(seed):
+    rng = np.random.default_rng(3000 + seed)
+    seq_len = int(rng.integers(60, 240))
+    half = int(rng.integers(3, 7))
+    er = float(rng.choice([0.0, 0.01, 0.04]))
+    truth, reads1 = generate_test(4, seq_len, half, er, seed=4000 + seed)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=int(rng.integers(1, 4)), replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    reads = list(reads1) + [
+        corrupt(bytes(h2), er, np.random.default_rng(5000 + seed * 16 + i))
+        for i in range(half)
+    ]
+    over = {
+        "l2": bool(rng.integers(0, 2)),
+        "weighted": bool(rng.integers(0, 2)),
+        "min_count": int(rng.integers(1, 4)),
+    }
+    engines = []
+    for backend in ("python", "jax"):
+        e = DualConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), **over)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        engines.append(e)
+    assert engines[0].consensus() == engines[1].consensus()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_single_engine_offset_fuzz(seed):
+    """Late-starting reads: the windowed activation path plus the
+    gather-variant (non-uniform-offset) device kernels."""
+    rng = np.random.default_rng(6000 + seed)
+    seq_len = int(rng.integers(150, 300))
+    truth, reads = generate_test(4, seq_len, 4, 0.01, seed=7000 + seed)
+    offsets = [int(rng.integers(30, seq_len // 2)) for _ in range(2)]
+    engines = []
+    for backend in ("python", "jax"):
+        e = ConsensusDWFA(_cfg(backend, np.random.default_rng(seed), min_count=2))
+        for r in reads:
+            e.add_sequence(r)
+        for off in offsets:
+            e.add_sequence_offset(truth[off:], off)
+        engines.append(e)
+    want = engines[0].consensus()
+    got = engines[1].consensus()
+    assert [(c.sequence, c.scores) for c in want] == [
+        (c.sequence, c.scores) for c in got
+    ]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_dual_locked_side_fuzz(seed):
+    """Haplotypes of different lengths: the shorter side finishes and
+    LOCKS while the longer keeps extending — exercising the
+    one-side-locked device run mode against the per-symbol oracle."""
+    rng = np.random.default_rng(8000 + seed)
+    seq_len = int(rng.integers(80, 200))
+    extra = int(rng.integers(20, 60))
+    half = int(rng.integers(3, 6))
+    er = float(rng.choice([0.0, 0.01, 0.03]))
+    truth, reads1 = generate_test(4, seq_len, half, er, seed=9000 + seed)
+    tail, _ = generate_test(4, extra, 1, 0.0, seed=9500 + seed)
+    h2 = bytearray(truth)
+    h2[seq_len // 2] = (h2[seq_len // 2] + 1) % 4
+    h2 = bytes(h2) + tail
+    reads = list(reads1) + [
+        corrupt(h2, er, np.random.default_rng(9800 + seed * 16 + i))
+        for i in range(half)
+    ]
+    engines = []
+    for backend in ("python", "jax"):
+        e = DualConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=2)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        engines.append(e)
+    assert engines[0].consensus() == engines[1].consensus()

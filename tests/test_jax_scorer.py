@@ -369,7 +369,7 @@ def test_run_extend_forced_first_symbol():
     ref_stats = jx.push(ref, bytes([sym]))
 
     # losing node: other_cost 0 stops the run right after the forced step
-    steps, code, appended, stats = jx.run_extend(
+    steps, code, appended, stats, _recs = jx.run_extend(
         h, b"", 2**31 - 1, 0, 0, 2, False, 64, first_sym=sym_dense
     )
     assert steps == 1
@@ -386,7 +386,7 @@ def test_run_and_push_bundle_finalized_distances():
     config = CdwfaConfig(min_count=2)
     jx = JaxScorer(reads, config)
     h = jx.root(np.ones(4, dtype=bool))
-    steps, code, appended, stats = jx.run_extend(
+    steps, code, appended, stats, _recs = jx.run_extend(
         h, b"", 2**31 - 1, 2**31 - 1, 0, 2, False, 500
     )
     assert steps > 0

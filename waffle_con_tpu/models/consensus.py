@@ -439,7 +439,7 @@ class ConsensusDWFA:
             run_extend = getattr(scorer, "run_extend", None)
             reached_now = self._reached_end(node, cfg.allow_early_termination)
             force_sym = -1
-            if run_extend is not None and not reached_now:
+            if run_extend is not None:
                 passing_now = (
                     node.prefetch[0]
                     if node.prefetch is not None
@@ -447,9 +447,12 @@ class ConsensusDWFA:
                 )
                 # -- arena fast path: resolve the pop competition among
                 # the in-hand node and the next-best queue entries on
-                # device (see DualConsensusDWFA._arena_attempt)
+                # device (see DualConsensusDWFA._arena_attempt).  The
+                # arena has no record absorption, so reached nodes skip
+                # it (its step 0 would stop code 2)
                 if (
-                    len(passing_now) == 1
+                    not reached_now
+                    and len(passing_now) == 1
                     and getattr(scorer, "run_arena", None) is not None
                 ):
                     arena = self._arena_attempt(
@@ -480,7 +483,13 @@ class ConsensusDWFA:
                 # that would stop an unforced run at step 0 commits the
                 # identical symbol here: the host's f64 nomination IS
                 # the ground truth the kernel's EPS contract defers to.
-                if len(passing_now) == 1 and node.prefetch is None:
+                if (
+                    len(passing_now) == 1
+                    and node.prefetch is None
+                    and not reached_now
+                ):
+                    # (a reached pop must evaluate its record through the
+                    # kernel's loop checks, so it is never forced)
                     force_sym = int(scorer.sym_id[passing_now[0]])
                 engage = len(passing_now) == 1 and (
                     force_sym >= 0
@@ -511,7 +520,7 @@ class ConsensusDWFA:
                         if maximum_error != math.inf
                         else 2**31 - 1
                     )
-                    steps, _code, appended, run_stats = run_extend(
+                    steps, _code, appended, run_stats, records = run_extend(
                         node.handle,
                         node.consensus,
                         me_budget,
@@ -521,7 +530,41 @@ class ConsensusDWFA:
                         cost is ConsensusCost.L2_DISTANCE,
                         max_steps,
                         first_sym=force_sym,
+                        # under early termination the host's require-all
+                        # record condition can never hold while a read
+                        # is not yet activated, but the kernel's
+                        # conservative fold would buffer bogus records
+                        allow_records=(
+                            not cfg.allow_early_termination
+                            or all(node.active)
+                        ),
                     )
+                    # replay absorbed reached-state records in commit
+                    # order, exactly as the completion path would have at
+                    # each pop (the stopped state is NOT in the buffer —
+                    # its own pop records it below)
+                    for rec_j, rec_fin in records:
+                        if not all(node.active):
+                            scorer.free(node.handle)
+                            raise EngineError(
+                                "Finalize called on DWFA that was never initialized."
+                            )
+                        rec_scores = [cost.apply(int(v)) for v in rec_fin]
+                        rec_total = sum(rec_scores)
+                        if rec_total < maximum_error:
+                            maximum_error = rec_total
+                            results.clear()
+                        if (
+                            rec_total <= maximum_error
+                            and len(results) < cfg.max_return_size
+                        ):
+                            results.append(
+                                Consensus(
+                                    node.consensus + appended[:rec_j],
+                                    cost,
+                                    rec_scores,
+                                )
+                            )
                     # the snapshot matches the stopped position whether
                     # or not steps committed (steps == 0 leaves state
                     # as-is), so adopt it either way — its fin field

@@ -105,6 +105,54 @@ def _extend_active_tables(
         )
 
 
+
+def build_dual_record(
+    cost, n, fin1, fin2, act1, act2, cons1, cons2, is_dual
+):
+    """THE copy of the finalized-result arithmetic (reference
+    ``/root/reference/src/dual_consensus.rs:438-492`` semantics): per
+    read the better finalized side (ties side 1), lexicographic swap,
+    grouped + full score vectors.  Shared by ``_finalize`` (live scorer
+    fins) and the run-record replay (kernel-buffered fins) so the two
+    can never drift.  Returns ``(result, total, counts1, counts2)``;
+    raises for a read inactive on every tracked side."""
+    indices = []
+    best_scores = []
+    for r in range(n):
+        s1 = cost.apply(int(fin1[r])) if act1[r] else None
+        s2 = cost.apply(int(fin2[r])) if is_dual and act2[r] else None
+        if s1 is None and s2 is None:
+            raise EngineError(
+                "Finalize called on DWFA that was never initialized."
+            )
+        if s1 is not None and (s2 is None or s1 <= s2):
+            indices.append(0)
+            best_scores.append(s1)
+        else:
+            indices.append(1)
+            best_scores.append(s2)
+    swap = is_dual and cons2 < cons1
+    is_consensus1 = [(idx == 0) ^ swap for idx in indices]
+    grouped: List[List[int]] = [[], []]
+    for idx, score in zip(indices, best_scores):
+        grouped[idx].append(score)
+    c1 = Consensus(cons1, cost, grouped[0])
+    c2 = Consensus(cons2, cost, grouped[1])
+    full1 = [cost.apply(int(fin1[r])) if act1[r] else None for r in range(n)]
+    full2 = [
+        cost.apply(int(fin2[r])) if is_dual and act2[r] else None
+        for r in range(n)
+    ]
+    if swap:
+        result = DualConsensus(c2, c1, is_consensus1, full2, full1)
+    else:
+        result = DualConsensus(
+            c1, c2 if is_dual else None, is_consensus1, full1, full2
+        )
+    counts1 = sum(is_consensus1)
+    return result, sum(best_scores), counts1, n - counts1
+
+
 class _DualNode:
     """Search node holding one (non-dual) or two consensus branches."""
 
@@ -430,11 +478,31 @@ class DualConsensusDWFA:
             # flow.
             farthest_kind = farthest_dual if node.is_dual else farthest_single
             kind_tracker = dual_tracker if node.is_dual else single_tracker
+            #: one-side-locked dual runs engage only while the unlocked
+            #: side is at least as long as the locked one — the node's
+            #: max length then advances one per committed step, so the
+            #: tracker replay / run-bound simulation stay valid (in the
+            #: brief opposite regime the per-symbol flow handles it)
+            lockable = (
+                not (node.lock1 and node.lock2)
+                and (
+                    not node.lock1
+                    or len(node.consensus2) >= len(node.consensus1)
+                )
+                and (
+                    not node.lock2
+                    or len(node.consensus1) >= len(node.consensus2)
+                )
+            )
+            #: both run kernels absorb reached-state records (buffered
+            #: finalized snapshots replayed after the call), so reached
+            #: nodes engage the plain runs; only the arena (no record
+            #: support) skips them
+            reached_now = node.reached_all_end(cfg.allow_early_termination)
             runnable = cfg.min_af == 0.0 and (
                 (
                     node.is_dual
-                    and not node.lock1
-                    and not node.lock2
+                    and lockable
                     and getattr(scorer, "run_extend_dual", None) is not None
                 )
                 or (
@@ -449,11 +517,14 @@ class DualConsensusDWFA:
                     else self._build_specs(scorer, node)
                 )
                 if node.is_dual:
+                    # the single-child spec: both sides extend, or the
+                    # locked side contributes its forced None
                     runnable = (
                         len(specs_now) == 1
                         and specs_now[0][0] == "dual"
-                        and specs_now[0][1] is not None
-                        and specs_now[0][2] is not None
+                        and (specs_now[0][1] is not None or node.lock1)
+                        and (specs_now[0][2] is not None or node.lock2)
+                        and (specs_now[0][1] is not None or specs_now[0][2] is not None)
                     )
                 else:
                     runnable = len(specs_now) == 1 and specs_now[0][0] == "single"
@@ -462,7 +533,12 @@ class DualConsensusDWFA:
             # device (>99% of plain-run stops are "would lose the next
             # pop"); falls back to the single-node run below when not
             # engaged.  Commits update both nodes + exact tracker replay.
-            if runnable and getattr(scorer, "run_arena", None) is not None:
+            if (
+                runnable
+                and not reached_now
+                and not (node.is_dual and (node.lock1 or node.lock2))
+                and getattr(scorer, "run_arena", None) is not None
+            ):
                 arena = self._arena_attempt(
                     scorer, pqueue, node, top_cost, maximum_error,
                     activate_points, cost, single_tracker, dual_tracker,
@@ -511,6 +587,13 @@ class DualConsensusDWFA:
                             else 2**31 - 1
                         )
                         l2 = cost is ConsensusCost.L2_DISTANCE
+                        # see the single engine: records are only valid
+                        # under early termination when every read is
+                        # already active on some tracked side
+                        allow_recs = not cfg.allow_early_termination or all(
+                            a1 or (node.is_dual and a2)
+                            for a1, a2 in zip(node.active1, node.active2)
+                        )
                         if node.is_dual:
                             (
                                 steps,
@@ -521,6 +604,7 @@ class DualConsensusDWFA:
                                 stats2,
                                 act1,
                                 act2,
+                                dual_records,
                             ) = scorer.run_extend_dual(
                                 node.h1,
                                 node.h2,
@@ -535,9 +619,41 @@ class DualConsensusDWFA:
                                 l2,
                                 cfg.weighted_by_ed,
                                 max_steps,
+                                lock1=node.lock1,
+                                lock2=node.lock2,
+                                allow_records=allow_recs,
                             )
+                            # replay absorbed reached-state records in
+                            # commit order — the exact _finalize +
+                            # completion-path arithmetic, fed from the
+                            # kernel's buffered snapshots
+                            for rec_j, rf1, rf2, ra1, ra2 in dual_records:
+                                try:
+                                    (rec_result, rec_total, counts1,
+                                     counts2) = build_dual_record(
+                                        cost, n_seqs, rf1, rf2, ra1, ra2,
+                                        node.consensus1 + app1[:rec_j],
+                                        node.consensus2 + app2[:rec_j],
+                                        True,
+                                    )
+                                except EngineError:
+                                    self._free_node(scorer, node)
+                                    raise
+                                if (
+                                    counts1 >= full_min_count
+                                    and counts2 >= full_min_count
+                                ):
+                                    if rec_total < maximum_error:
+                                        maximum_error = rec_total
+                                        results.clear()
+                                    if (
+                                        rec_total <= maximum_error
+                                        and len(results) < cfg.max_return_size
+                                    ):
+                                        results.append(rec_result)
                         else:
-                            steps, _code, app1, stats1 = scorer.run_extend(
+                            (steps, _code, app1, stats1,
+                             run_records) = scorer.run_extend(
                                 node.h1,
                                 node.consensus1,
                                 me_budget,
@@ -546,7 +662,32 @@ class DualConsensusDWFA:
                                 cfg.min_count,
                                 l2,
                                 max_steps,
+                                allow_records=allow_recs,
                             )
+                            # replay absorbed reached-state records (the
+                            # non-dual form of the completion path: no
+                            # imbalance check, side 2 empty)
+                            for rec_j, rec_fin in run_records:
+                                try:
+                                    (rec_result, rec_total, _c1,
+                                     _c2) = build_dual_record(
+                                        cost, n_seqs, rec_fin,
+                                        np.zeros(n_seqs, dtype=np.int64),
+                                        node.active1, node.active2,
+                                        node.consensus1 + app1[:rec_j],
+                                        node.consensus2, False,
+                                    )
+                                except EngineError:
+                                    self._free_node(scorer, node)
+                                    raise
+                                if rec_total < maximum_error:
+                                    maximum_error = rec_total
+                                    results.clear()
+                                if (
+                                    rec_total <= maximum_error
+                                    and len(results) < cfg.max_return_size
+                                ):
+                                    results.append(rec_result)
                         if steps > 0:
                             # the branches advanced past the prefetched children
                             self._drop_prefetch(scorer, node)
@@ -976,46 +1117,11 @@ class DualConsensusDWFA:
             if node.is_dual
             else np.zeros(n, dtype=np.int64)
         )
-
-        # per-read best side from finalized scores (ties -> side 1)
-        indices = []
-        best_scores = []
-        for r in range(n):
-            s1 = cost.apply(int(fin1[r])) if node.active1[r] else None
-            s2 = (
-                cost.apply(int(fin2[r]))
-                if node.is_dual and node.active2[r]
-                else None
-            )
-            if s1 is not None and (s2 is None or s1 <= s2):
-                indices.append(0)
-                best_scores.append(s1)
-            else:
-                indices.append(1)
-                best_scores.append(s2)
-
-        swap = node.is_dual and node.consensus2 < node.consensus1
-        is_consensus1 = [(idx == 0) ^ swap for idx in indices]
-        grouped_scores: List[List[int]] = [[], []]
-        for idx, score in zip(indices, best_scores):
-            grouped_scores[idx].append(score)
-
-        c1 = Consensus(node.consensus1, cost, grouped_scores[0])
-        c2 = Consensus(node.consensus2, cost, grouped_scores[1])
-        full1 = [
-            cost.apply(int(fin1[r])) if node.active1[r] else None for r in range(n)
-        ]
-        full2 = [
-            cost.apply(int(fin2[r])) if node.is_dual and node.active2[r] else None
-            for r in range(n)
-        ]
-        if swap:
-            result = DualConsensus(c2, c1, is_consensus1, full2, full1)
-        else:
-            result = DualConsensus(
-                c1, c2 if node.is_dual else None, is_consensus1, full1, full2
-            )
-        return result, sum(best_scores)
+        result, total, _c1, _c2 = build_dual_record(
+            cost, n, fin1, fin2, node.active1, node.active2,
+            node.consensus1, node.consensus2, node.is_dual,
+        )
+        return result, total
 
     # ==================================================================
     # expansion

@@ -74,6 +74,11 @@ INF = np.int32(1 << 20)
 #: bypass it entirely, so clean stretches never false-stop).
 VOTE_EPS = np.float32(1e-2)
 
+#: capacity of the run loops' record-absorption buffers (finalized
+#: snapshots of reached states committed through); a run needing more
+#: records than this stops with code 2 and the host continues normally
+REC_CAP = 256
+
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
     return max(minimum, 1 << max(0, (n - 1).bit_length()))
@@ -566,9 +571,21 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     within ``me_budget`` (the best finalized result so far).
 
     Stop codes: 1 = votes need host arbitration (non-one-hot, wildcard
-    votes, or #passing != 1), 2 = some read reached its baseline end,
-    3 = node would lose the next pop (budget/priority), 4 = step limit,
-    5 = band overflow (last push not committed).
+    votes, or #passing != 1), 2 = a read reached its baseline end AND
+    the record cannot be absorbed (finalized distances out of band, an
+    L2 overflow, or the record buffer is full), 3 = node would lose the
+    next pop (budget/priority), 4 = step limit, 5 = band overflow (last
+    push not committed).
+
+    RECORD ABSORPTION: a reached state no longer stops the run by
+    itself.  The host's pop at such a state records a finalized result
+    (budget/result-list updates) and then extends normally; the kernel
+    does the same — each committed step through a reached state appends
+    ``(step, finalized_eds)`` to a bounded record buffer and updates its
+    running ``me_budget`` exactly as an accepted record would
+    (``fin_total < budget``), and the host replays the buffered records
+    afterwards.  The STOPPED state is never buffered: the host re-pops
+    it and records it through the normal completion path.
 
     ``params[8]`` is an optional FORCED first symbol (or -1): the host
     has already nominated this node's unique passing child exactly (the
@@ -588,9 +605,16 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     for clean stretches the consensus grows entirely on device, with one
     host round-trip per *event* instead of per base.
 
-    ``params`` is ``[9] int32`` — (slot, me_budget, other_cost, other_len,
-    min_count, l2, max_steps, off0, first_sym) — packed into a single
-    host upload.
+    ``params`` is ``[10] int32`` — (slot, me_budget, other_cost,
+    other_len, min_count, l2, max_steps, off0, first_sym,
+    allow_records) — packed into a single host upload.
+    ``allow_records`` is 0 when the host's record condition cannot hold
+    mid-run (early termination with a not-yet-activated read: the
+    kernel's conservative reached fold counts inactive lanes as done,
+    but the host's require-all check never would) — absorption is then
+    disabled and reached states stop with code 2 as before.  Returns
+    ``(state, steps, code, stats, cons, fin_eds, fin_ovf, rec_count,
+    rec_steps, rec_fins)``.
     """
     h = params[0]
     me_budget = params[1]
@@ -600,6 +624,7 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     l2 = params[5].astype(bool)
     max_steps = params[6]
     off0 = params[7]
+    allow_records = params[9].astype(bool)
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -627,7 +652,8 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         )
 
     def body(carry):
-        D, e, rmin, er, cons, clen, steps, _code = carry
+        (D, e, rmin, er, cons, clen, steps, budget,
+         rec_count, rec_steps, rec_fins, _code) = carry
         eds, occ, split, reached = stats_at(D, e, rmin, er, clen)
         # int32-safe cost total: with L2 and huge per-read distances the
         # squared sum could wrap, so treat that regime as a host event
@@ -680,16 +706,29 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         # kernel cannot tell a padding/non-member lane (must not block)
         # from a real inactive read (blocks recording host-side); the
         # host re-checks the real condition at the stop pop.
-        reached_stop = jnp.where(et, (reached | ~act).all(), reached.any())
+        reached_here = jnp.where(et, (reached | ~act).all(), reached.any())
+        # finalized snapshot of THIS (pre-push) state: the host records
+        # it at this pop; absorbing the record needs it in-band
+        fin_j, fin_ovf_j = _finalized(e, rmin, act, E)
+        fin_costs = jnp.where(l2, fin_j * fin_j, fin_j)
+        fin_total = jnp.where(act, fin_costs, 0).sum()
+        fin_cost_ovf = l2 & (jnp.where(act, fin_j, 0).max() > 2048)
+        rec_blocked = (
+            ~allow_records
+            | fin_ovf_j
+            | fin_cost_ovf
+            | (rec_count >= REC_CAP)
+        )
+
         wins_pop = (total < other_cost) | (
             (total == other_cost) & (clen > other_len)
         )
         code = jnp.where(
-            reached_stop,
-            2,
+            (total > budget) | ~wins_pop,
+            3,
             jnp.where(
-                (total > me_budget) | ~wins_pop,
-                3,
+                reached_here & rec_blocked,
+                2,
                 jnp.where(
                     dirty,
                     1,
@@ -705,6 +744,18 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         ovf = (act & (e2 >= E)).any()
         commit = (code == 0) & ~ovf
         code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
+        # record of the popped state, buffered only when the step commits
+        # (a stopped state is recorded by the host's own completion path)
+        do_rec = commit & reached_here
+        ri = jnp.clip(rec_count, 0, REC_CAP - 1)
+        rec_steps = jnp.where(do_rec, rec_steps.at[ri].set(steps), rec_steps)
+        rec_fins = jnp.where(do_rec, rec_fins.at[ri].set(fin_j), rec_fins)
+        rec_count = rec_count + do_rec.astype(jnp.int32)
+        # accepted records shrink the running budget exactly as the host
+        # does (strictly-better totals only; appends don't change it)
+        budget = jnp.where(
+            do_rec & (fin_total < budget), fin_total, budget
+        )
         D = jnp.where(commit, D2, D)
         e = jnp.where(commit, e2, e)
         rmin = jnp.where(commit, rmin2, rmin)
@@ -712,7 +763,8 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         cons = jnp.where(commit, cons2, cons)
         clen = jnp.where(commit, clen2, clen)
         steps = steps + commit.astype(steps.dtype)
-        return D, e, rmin, er, cons, clen, steps, code
+        return (D, e, rmin, er, cons, clen, steps, budget,
+                rec_count, rec_steps, rec_fins, code)
 
     D0 = state["D"][h]
     e0 = state["e"][h]
@@ -744,9 +796,21 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     def unforced(_):
         return (D0, e0, rmin0, er0, cons0, clen0, jnp.int32(0), jnp.int32(0))
 
-    init = lax.cond(first_sym >= 0, forced, unforced, None)
-    D, e, rmin, er, cons, clen, steps, code = lax.while_loop(
-        lambda c: c[7] == 0, body, init
+    (D1, e1, rmin1, er1, cons1, clen1, steps0, code0) = lax.cond(
+        first_sym >= 0, forced, unforced, None
+    )
+    R = rlen.shape[0]
+    init = (
+        D1, e1, rmin1, er1, cons1, clen1, steps0,
+        me_budget,
+        jnp.int32(0),
+        jnp.zeros((REC_CAP,), jnp.int32),
+        jnp.zeros((REC_CAP, R), jnp.int32),
+        code0,
+    )
+    (D, e, rmin, er, cons, clen, steps, _budget,
+     rec_count, rec_steps, rec_fins, code) = lax.while_loop(
+        lambda c: c[11] == 0, body, init
     )
     stats = stats_at(D, e, rmin, er, clen)
     fin_eds, fin_ovf = _finalized(e, rmin, act, E)
@@ -757,7 +821,10 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     out["er"] = state["er"].at[h].set(er)
     out["cons"] = state["cons"].at[h].set(cons)
     out["clen"] = state["clen"].at[h].set(clen)
-    return out, steps, code, stats, cons, fin_eds, fin_ovf
+    return (
+        out, steps, code, stats, cons, fin_eds, fin_ovf,
+        rec_count, rec_steps, rec_fins,
+    )
 
 
 def _dual_votes(occ, split, w, wc, weighted):
@@ -803,10 +870,17 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
 
     ``uniform`` (static) selects slice- vs gather-sourced read windows
     (see ``_j_run``); ``params[11]``/``params[12]`` carry each side's
-    shared active-read offset when uniform.
+    shared active-read offset when uniform.  ``params[13]``/``params[14]``
+    are the sides' lock flags: a locked side is frozen — no votes, no
+    column step, length fixed — while its tracked reads keep
+    contributing their (frozen) distances to the node cost, divergence
+    pruning, and the reached fold; its forced do-not-extend option is
+    the host's only choice for that side, so it never triggers
+    arbitration by itself.
 
-    Preconditions (enforced by the engine): neither side locked, and
-    ``min_af == 0`` so the vote thresholds are static.
+    Preconditions (enforced by the engine): at most one side locked
+    (with the unlocked side at least as long), and ``min_af == 0`` so
+    the vote thresholds are static.
 
     Stop codes: 1 = host arbitration (ambiguous votes, != 1 passing
     symbol on a side, a side ran out of candidates, or a side finished),
@@ -821,9 +895,12 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     stretches cost one host round-trip per *event*, not ~5 dispatches per
     appended base.
 
-    ``params`` is ``[11] int32`` — (slot_a, slot_b, me_budget, other_cost,
+    ``params`` is ``[16] int32`` — (slot_a, slot_b, me_budget, other_cost,
     other_len, min_count, dual_max_ed_delta, imb_min, l2, weighted,
-    max_steps) — packed into a single host upload.
+    max_steps, off0a, off0b, lock1, lock2, allow_records) — packed into
+    a single host upload (``allow_records``: see ``_j_run``; here the
+    host condition is every read active on at least one side under
+    early termination).
     """
     ha = params[0]
     hb = params[1]
@@ -838,6 +915,9 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     max_steps = params[10]
     off0a = params[11]
     off0b = params[12]
+    lock_a = params[13].astype(bool)
+    lock_b = params[14].astype(bool)
+    allow_records = params[15].astype(bool)
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -868,7 +948,9 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
 
     def body(carry):
         (Da, ea, rmina, era, acta, consa, clena,
-         Db, eb, rminb, erb, actb, consb, clenb, steps, _code) = carry
+         Db, eb, rminb, erb, actb, consb, clenb, steps, budget,
+         rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
+         _code) = carry
 
         edsa, occa, splita, reacheda = stats_at(
             Da, ea, rmina, era, offa, acta, clena, off0a
@@ -927,6 +1009,10 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
 
         dirty_a, sym_a = side(occa, splita, wa)
         dirty_b, sym_b = side(occb, splitb, wb)
+        # a locked side never arbitrates: its do-not-extend option is
+        # forced, so its votes and finished flag are moot
+        dirty_a = dirty_a & ~lock_a
+        dirty_b = dirty_b & ~lock_b
 
         # a side counting as finished adds a do-not-extend option to the
         # host's cross product — host arbitration either way
@@ -955,14 +1041,42 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
             (total == other_cost) & (cur_len > other_len)
         )
 
+        # record eval of THIS (pre-push) state, mirroring _finalize: per
+        # read, the better finalized side (ties side 1), acceptance
+        # gated by the finalized-assignment imbalance re-check
+        fin1_j, fo1 = _finalized(ea, rmina, acta, E)
+        fin2_j, fo2 = _finalized(eb, rminb, actb, E)
+        fc1 = jnp.where(l2, fin1_j * fin1_j, fin1_j)
+        fc2 = jnp.where(l2, fin2_j * fin2_j, fin2_j)
+        side0 = acta & (~actb | (fc1 <= fc2))
+        any_act = acta | actb
+        fin_total = jnp.where(any_act, jnp.where(side0, fc1, fc2), 0).sum()
+        count0 = (side0 & any_act).sum()
+        count1 = any_act.sum() - count0
+        rec_imbalanced = (count0 < min_count) | (count1 < min_count)
+        fin_cost_ovf = l2 & (
+            jnp.maximum(
+                jnp.where(acta, fin1_j, 0).max(),
+                jnp.where(actb, fin2_j, 0).max(),
+            )
+            > 2048
+        )
+        rec_blocked = (
+            ~allow_records | fo1 | fo2 | fin_cost_ovf | (rec_count >= REC_CAP)
+        )
+
         code = jnp.where(
-            reached_stop,
-            2,
+            (total > budget) | ~wins_pop,
+            3,
             jnp.where(
-                (total > me_budget) | ~wins_pop,
-                3,
+                reached_stop & rec_blocked,
+                2,
                 jnp.where(
-                    dirty_a | dirty_b | fin_a | fin_b | cost_overflow,
+                    dirty_a
+                    | dirty_b
+                    | (fin_a & ~lock_a)
+                    | (fin_b & ~lock_b)
+                    | cost_overflow,
                     1,
                     jnp.where(steps >= max_steps, 4, 0),
                 ),
@@ -977,6 +1091,18 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         Db2, eb2, rminb2, erb2 = col_at(
             Db, eb, rminb, erb, offb, actb, clenb + 1, off0b, sym_b
         )
+        # locked sides are frozen: discard their column step entirely
+        frz = lambda lock, new, old: jnp.where(lock, old, new)  # noqa: E731
+        Da2 = frz(lock_a, Da2, Da)
+        ea2 = frz(lock_a, ea2, ea)
+        rmina2 = frz(lock_a, rmina2, rmina)
+        era2 = frz(lock_a, era2, era)
+        consa2 = frz(lock_a, consa2, consa)
+        Db2 = frz(lock_b, Db2, Db)
+        eb2 = frz(lock_b, eb2, eb)
+        rminb2 = frz(lock_b, rminb2, rminb)
+        erb2 = frz(lock_b, erb2, erb)
+        consb2 = frz(lock_b, consb2, consb)
         ovf = ((acta & (ea2 >= E)) | (actb & (eb2 >= E))).any()
 
         # divergence pruning on post-push distances (host order:
@@ -992,6 +1118,22 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
             code,
             jnp.where(ovf, 5, jnp.where(imb, 6, 0)),
         )
+        # buffer the popped state's record on commit (the stopped state
+        # is recorded by the host's own completion path), and shrink the
+        # running budget exactly as an accepted record would
+        do_rec = commit & reached_stop
+        ri = jnp.clip(rec_count, 0, REC_CAP - 1)
+        rec_steps = jnp.where(do_rec, rec_steps.at[ri].set(steps), rec_steps)
+        rec_f1 = jnp.where(do_rec, rec_f1.at[ri].set(fin1_j), rec_f1)
+        rec_f2 = jnp.where(do_rec, rec_f2.at[ri].set(fin2_j), rec_f2)
+        rec_a1 = jnp.where(do_rec, rec_a1.at[ri].set(acta), rec_a1)
+        rec_a2 = jnp.where(do_rec, rec_a2.at[ri].set(actb), rec_a2)
+        rec_count = rec_count + do_rec.astype(jnp.int32)
+        budget = jnp.where(
+            do_rec & ~rec_imbalanced & (fin_total < budget),
+            fin_total,
+            budget,
+        )
         sel = lambda c, new, old: jnp.where(c, new, old)  # noqa: E731
         Da = sel(commit, Da2, Da)
         ea = sel(commit, ea2, ea)
@@ -999,28 +1141,40 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         era = sel(commit, era2, era)
         acta = sel(commit, acta2, acta)
         consa = sel(commit, consa2, consa)
-        clena = sel(commit, clena + 1, clena)
+        clena = sel(commit & ~lock_a, clena + 1, clena)
         Db = sel(commit, Db2, Db)
         eb = sel(commit, eb2, eb)
         rminb = sel(commit, rminb2, rminb)
         erb = sel(commit, erb2, erb)
         actb = sel(commit, actb2, actb)
         consb = sel(commit, consb2, consb)
-        clenb = sel(commit, clenb + 1, clenb)
+        clenb = sel(commit & ~lock_b, clenb + 1, clenb)
         steps = steps + commit.astype(steps.dtype)
         return (Da, ea, rmina, era, acta, consa, clena,
-                Db, eb, rminb, erb, actb, consb, clenb, steps, code)
+                Db, eb, rminb, erb, actb, consb, clenb, steps, budget,
+                rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
+                code)
 
+    R = rlen.shape[0]
     init = (
         state["D"][ha], state["e"][ha], state["rmin"][ha], state["er"][ha],
         state["act"][ha], state["cons"][ha], state["clen"][ha],
         state["D"][hb], state["e"][hb], state["rmin"][hb], state["er"][hb],
         state["act"][hb], state["cons"][hb], state["clen"][hb],
-        jnp.int32(0), jnp.int32(0),
+        jnp.int32(0), me_budget,
+        jnp.int32(0),
+        jnp.zeros((REC_CAP,), jnp.int32),
+        jnp.zeros((REC_CAP, R), jnp.int32),
+        jnp.zeros((REC_CAP, R), jnp.int32),
+        jnp.zeros((REC_CAP, R), bool),
+        jnp.zeros((REC_CAP, R), bool),
+        jnp.int32(0),
     )
     (Da, ea, rmina, era, acta, consa, clena,
-     Db, eb, rminb, erb, actb, consb, clenb, steps, code) = lax.while_loop(
-        lambda c: c[15] == 0, body, init
+     Db, eb, rminb, erb, actb, consb, clenb, steps, _budget,
+     rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
+     code) = lax.while_loop(
+        lambda c: c[22] == 0, body, init
     )
     stats_a = stats_at(Da, ea, rmina, era, offa, acta, clena, off0a)
     stats_b = stats_at(Db, eb, rminb, erb, offb, actb, clenb, off0b)
@@ -1032,7 +1186,10 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     out["act"] = state["act"].at[ha].set(acta).at[hb].set(actb)
     out["cons"] = state["cons"].at[ha].set(consa).at[hb].set(consb)
     out["clen"] = state["clen"].at[ha].set(clena).at[hb].set(clenb)
-    return out, steps, code, stats_a, stats_b, acta, actb, consa, consb
+    return (
+        out, steps, code, stats_a, stats_b, acta, actb, consa, consb,
+        rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
+    )
 
 
 @partial(
@@ -1941,16 +2098,19 @@ class JaxScorer(WavefrontScorer):
         l2: bool,
         max_steps: int,
         first_sym: int = -1,
-    ) -> Tuple[int, int, bytes, BranchStats]:
+        allow_records: bool = True,
+    ) -> Tuple[int, int, bytes, BranchStats, list]:
         """Device-side unambiguous-run extension; returns
-        ``(steps_committed, stop_code, appended_bytes, stats)`` with
-        ``stats`` the branch snapshot at the stopped position, its
+        ``(steps_committed, stop_code, appended_bytes, stats, records)``
+        with ``stats`` the branch snapshot at the stopped position, its
         ``fin`` field carrying the finalized per-read distances there
-        (``None`` when the band cannot express them) — both saving their
-        own follow-up dispatches.  ``first_sym`` (a dense id, or -1)
-        force-pushes the host's already-nominated unique child as step 0.
-        See ``_j_run`` for the stop-code contract; on overflow the band
-        is grown so the caller can simply continue stepping."""
+        (``None`` when the band cannot express them), and ``records``
+        the absorbed reached-state snapshots ``[(step, fin_eds), ...]``
+        in commit order (see ``_j_run``) for the engine to replay.
+        ``first_sym`` (a dense id, or -1) force-pushes the host's
+        already-nominated unique child as step 0.  See ``_j_run`` for
+        the stop-code contract; on overflow the band is grown so the
+        caller can simply continue stepping."""
         self._invalidate_root_stats()
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
@@ -1967,17 +2127,27 @@ class JaxScorer(WavefrontScorer):
                 max_steps,
                 off0,
                 first_sym,
+                int(allow_records),
             ],
             dtype=np.int32,
         )
-        state, steps, code, stats, cons_row, fin_eds, fin_ovf = _j_run(
+        (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
+         rec_count, rec_steps, rec_fins) = _j_run(
             self._state, self._reads, self._reads_pad, self._rlen, params,
             self._wc, self._et, self._A, uniform,
         )
         self._state = state
-        steps, code, stats_np, cons_np, fin_np, fin_ovf = jax.device_get(
-            (steps, code, stats, cons_row, fin_eds, fin_ovf)
+        (steps, code, stats_np, cons_np, fin_np, fin_ovf,
+         rec_count) = jax.device_get(
+            (steps, code, stats, cons_row, fin_eds, fin_ovf, rec_count)
         )
+        # the record buffers only ride home when something was absorbed
+        # (most run calls have none, and every fetched byte costs tunnel
+        # round-trip time)
+        if int(rec_count):
+            rec_steps_np, rec_fins_np = jax.device_get(
+                (rec_steps, rec_fins)
+            )
         steps = int(steps)
         code = int(code)
         self.counters["run_calls"] += 1
@@ -1990,9 +2160,14 @@ class JaxScorer(WavefrontScorer):
             appended = self.symtab[ids].astype(np.uint8).tobytes()
         if code == 5:
             self._grow_e()
+        n = self.num_reads
+        records = [
+            (int(rec_steps_np[i]), rec_fins_np[i, :n].astype(np.int64))
+            for i in range(int(rec_count))
+        ]  # rec_count == 0 -> empty without touching the buffers
         return steps, code, appended, self._stats_np(
             stats_np + (fin_np, np.logical_not(fin_ovf))
-        )
+        ), records
 
     def run_extend_dual(
         self,
@@ -2009,12 +2184,19 @@ class JaxScorer(WavefrontScorer):
         l2: bool,
         weighted: bool,
         max_steps: int,
+        lock1: bool = False,
+        lock2: bool = False,
+        allow_records: bool = True,
     ):
         """Device-side dual-node extension (both branches step together,
         with on-device divergence pruning); returns ``(steps, stop_code,
-        appended1, appended2, stats1, stats2, active1, active2)``.  See
-        ``_j_run_dual`` for the stop-code contract.  Caller preconditions:
-        neither side locked, ``min_af == 0``."""
+        appended1, appended2, stats1, stats2, active1, active2,
+        records)`` with ``records`` the absorbed reached-state snapshots
+        ``[(step, fin1, fin2, act1, act2), ...]`` in commit order for
+        the engine to replay (cf. ``_j_run``'s record absorption).  See
+        ``_j_run_dual`` for the stop-code contract (including the
+        one-side-locked mode).  Caller preconditions: at most one side
+        locked, ``min_af == 0``."""
         self._invalidate_root_stats()
         s1 = self._slot_of[h1]
         s2 = self._slot_of[h2]
@@ -2038,10 +2220,14 @@ class JaxScorer(WavefrontScorer):
                 max_steps,
                 off0a,
                 off0b,
+                int(lock1),
+                int(lock2),
+                int(allow_records),
             ],
             dtype=np.int32,
         )
-        state, steps, code, stats1, stats2, act1, act2, consa, consb = (
+        (state, steps, code, stats1, stats2, act1, act2, consa, consb,
+         rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2) = (
             _j_run_dual(
                 self._state, self._reads, self._reads_pad, self._rlen,
                 params, self._wc, self._et, self._A, uni1 and uni2,
@@ -2049,9 +2235,15 @@ class JaxScorer(WavefrontScorer):
         )
         self._state = state
         (steps, code, stats1_np, stats2_np, act1_np, act2_np,
-         consa_np, consb_np) = jax.device_get(
-            (steps, code, stats1, stats2, act1, act2, consa, consb)
+         consa_np, consb_np, rec_count) = jax.device_get(
+            (steps, code, stats1, stats2, act1, act2, consa, consb,
+             rec_count)
         )
+        if int(rec_count):
+            (rec_steps_np, rec_f1_np, rec_f2_np, rec_a1_np,
+             rec_a2_np) = jax.device_get(
+                (rec_steps, rec_f1, rec_f2, rec_a1, rec_a2)
+            )
         steps = int(steps)
         code = int(code)
         self.counters["run_dual_calls"] += 1
@@ -2059,14 +2251,25 @@ class JaxScorer(WavefrontScorer):
         key = f"run_dual_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
 
-        def appended(cons_np, consensus):
-            if not steps:
+        def appended(cons_np, consensus, locked):
+            if not steps or locked:
                 return b""
             ids = cons_np[len(consensus) : len(consensus) + steps]
             return self.symtab[ids].astype(np.uint8).tobytes()
 
-        app1 = appended(consa_np, consensus1)
-        app2 = appended(consb_np, consensus2)
+        app1 = appended(consa_np, consensus1, lock1)
+        app2 = appended(consb_np, consensus2, lock2)
+        n = self.num_reads
+        records = [
+            (
+                int(rec_steps_np[i]),
+                rec_f1_np[i, :n].astype(np.int64),
+                rec_f2_np[i, :n].astype(np.int64),
+                rec_a1_np[i, :n],
+                rec_a2_np[i, :n],
+            )
+            for i in range(int(rec_count))
+        ]  # rec_count == 0 -> empty without touching the buffers
         # divergence pruning deactivates lanes on device; keep the host
         # act mirror exact or _uniform_off goes stale and silently drops
         # the dynamic-slice fast path for this branch and its clones
@@ -2074,7 +2277,6 @@ class JaxScorer(WavefrontScorer):
         self._act_host[s2] = act2_np
         if code == 5:
             self._grow_e()
-        n = self.num_reads
         return (
             steps,
             code,
@@ -2084,6 +2286,7 @@ class JaxScorer(WavefrontScorer):
             self._stats_np(stats2_np),
             act1_np[:n],
             act2_np[:n],
+            records,
         )
 
     #: fixed history capacity of the arena kernel (static shape: one

@@ -370,13 +370,20 @@ class SubsetScorer(WavefrontScorer):
 
     # -- device fast paths (shadowed with None when the base lacks them)
     def run_extend(self, h, consensus, *args, **kwargs):
-        steps, code, appended, stats = self.base.run_extend(
+        steps, code, appended, stats, records = self.base.run_extend(
             h, consensus, *args, **kwargs
         )
-        return steps, code, appended, self._slice(stats)
+        idx = self.indices
+        return (
+            steps,
+            code,
+            appended,
+            self._slice(stats),
+            [(j, fin[idx]) for j, fin in records],
+        )
 
     def run_extend_dual(self, h1, h2, consensus1, consensus2, *args, **kwargs):
-        (steps, code, app1, app2, stats1, stats2, act1, act2) = (
+        (steps, code, app1, app2, stats1, stats2, act1, act2, records) = (
             self.base.run_extend_dual(h1, h2, consensus1, consensus2, *args, **kwargs)
         )
         idx = self.indices
@@ -389,6 +396,10 @@ class SubsetScorer(WavefrontScorer):
             self._slice(stats2),
             act1[idx],
             act2[idx],
+            [
+                (j, f1[idx], f2[idx], a1[idx], a2[idx])
+                for j, f1, f2, a1, a2 in records
+            ],
         )
 
     def run_arena(self, *args, **kwargs):
