@@ -241,6 +241,21 @@ def requeue_arena_nodes(
             on_duplicate(idx, nd)
 
 
+def accept_record(maximum_error, results, total, result, max_return_size):
+    """THE copy of result acceptance (reference completion semantics,
+    ``/root/reference/src/consensus.rs:261-278``): a strictly better
+    total resets the budget and clears the tied set; totals at the
+    budget append up to ``max_return_size``.  Returns the new budget.
+    Shared by the completion paths and the run-record replays so they
+    can never drift."""
+    if total < maximum_error:
+        maximum_error = total
+        results.clear()
+    if total <= maximum_error and len(results) < max_return_size:
+        results.append(result)
+    return maximum_error
+
+
 def candidates_from_stats(
     stats: BranchStats,
     symtab: np.ndarray,
@@ -550,21 +565,17 @@ class ConsensusDWFA:
                                 "Finalize called on DWFA that was never initialized."
                             )
                         rec_scores = [cost.apply(int(v)) for v in rec_fin]
-                        rec_total = sum(rec_scores)
-                        if rec_total < maximum_error:
-                            maximum_error = rec_total
-                            results.clear()
-                        if (
-                            rec_total <= maximum_error
-                            and len(results) < cfg.max_return_size
-                        ):
-                            results.append(
-                                Consensus(
-                                    node.consensus + appended[:rec_j],
-                                    cost,
-                                    rec_scores,
-                                )
-                            )
+                        maximum_error = accept_record(
+                            maximum_error,
+                            results,
+                            sum(rec_scores),
+                            Consensus(
+                                node.consensus + appended[:rec_j],
+                                cost,
+                                rec_scores,
+                            ),
+                            cfg.max_return_size,
+                        )
                     # the snapshot matches the stopped position whether
                     # or not steps committed (steps == 0 leaves state
                     # as-is), so adopt it either way — its fin field
@@ -613,12 +624,13 @@ class ConsensusDWFA:
                     else scorer.finalized_eds(node.handle, node.consensus)
                 )
                 fin_scores = [cost.apply(int(e)) for e in fin_eds]
-                fin_total = sum(fin_scores)
-                if fin_total < maximum_error:
-                    maximum_error = fin_total
-                    results.clear()
-                if fin_total <= maximum_error and len(results) < cfg.max_return_size:
-                    results.append(Consensus(node.consensus, cost, fin_scores))
+                maximum_error = accept_record(
+                    maximum_error,
+                    results,
+                    sum(fin_scores),
+                    Consensus(node.consensus, cost, fin_scores),
+                    cfg.max_return_size,
+                )
 
             # -- nominate + expand (with frontier-synchronous batching:
             # the popped node's children and the next best queued nodes'

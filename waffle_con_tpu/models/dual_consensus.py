@@ -22,6 +22,7 @@ from waffle_con_tpu.models.consensus import (
     RUN_SIM_CAP,
     Consensus,
     EngineError,
+    accept_record,
     candidates_from_stats,
     replay_arena_history,
     replay_run_bookkeeping,
@@ -643,14 +644,10 @@ class DualConsensusDWFA:
                                     counts1 >= full_min_count
                                     and counts2 >= full_min_count
                                 ):
-                                    if rec_total < maximum_error:
-                                        maximum_error = rec_total
-                                        results.clear()
-                                    if (
-                                        rec_total <= maximum_error
-                                        and len(results) < cfg.max_return_size
-                                    ):
-                                        results.append(rec_result)
+                                    maximum_error = accept_record(
+                                        maximum_error, results, rec_total,
+                                        rec_result, cfg.max_return_size,
+                                    )
                         else:
                             (steps, _code, app1, stats1,
                              run_records) = scorer.run_extend(
@@ -680,14 +677,10 @@ class DualConsensusDWFA:
                                 except EngineError:
                                     self._free_node(scorer, node)
                                     raise
-                                if rec_total < maximum_error:
-                                    maximum_error = rec_total
-                                    results.clear()
-                                if (
-                                    rec_total <= maximum_error
-                                    and len(results) < cfg.max_return_size
-                                ):
-                                    results.append(rec_result)
+                                maximum_error = accept_record(
+                                    maximum_error, results, rec_total,
+                                    rec_result, cfg.max_return_size,
+                                )
                         if steps > 0:
                             # the branches advanced past the prefetched children
                             self._drop_prefetch(scorer, node)
@@ -766,14 +759,10 @@ class DualConsensusDWFA:
                         counts1 < full_min_count or counts2 < full_min_count
                     )
                 if not imbalanced:
-                    if fin_total < maximum_error:
-                        maximum_error = fin_total
-                        results.clear()
-                    if (
-                        fin_total <= maximum_error
-                        and len(results) < cfg.max_return_size
-                    ):
-                        results.append(fin_result)
+                    maximum_error = accept_record(
+                        maximum_error, results, fin_total, fin_result,
+                        cfg.max_return_size,
+                    )
                 else:
                     logger.debug("Finalized node is imbalanced, ignoring.")
 

@@ -2303,7 +2303,7 @@ class JaxScorer(WavefrontScorer):
     #: node capacity of the arena kernel (static; dead-node padding).
     #: Sized for the live-chain count of tie-heavy dual searches; per-
     #: iteration compute scales with K but stays tiny for a TPU VPU
-    ARENA_K = 32
+    ARENA_K = 48
 
     def run_arena(
         self,
