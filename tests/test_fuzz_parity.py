@@ -139,3 +139,76 @@ def test_dual_locked_side_fuzz(seed):
             e.add_sequence(r)
         engines.append(e)
     assert engines[0].consensus() == engines[1].consensus()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_single_engine_wildcard_fuzz(seed):
+    """Wildcard reads (the '*' base matches anything): exercises the
+    kernels' wildcard vote-drop and match paths against the oracle."""
+    rng = np.random.default_rng(11000 + seed)
+    seq_len = int(rng.integers(50, 160))
+    truth, reads = generate_test(4, seq_len, 6, 0.02, seed=12000 + seed)
+    star = ord("*")
+    wc_reads = []
+    for r in reads:
+        arr = bytearray(r)
+        for pos in rng.choice(
+            len(arr), size=max(1, len(arr) // 20), replace=False
+        ):
+            arr[pos] = star
+        wc_reads.append(bytes(arr))
+    engines = []
+    for backend in ("python", "jax"):
+        cfg = (
+            CdwfaConfigBuilder()
+            .backend(backend)
+            .min_count(2)
+            .wildcard(star)
+            .build()
+        )
+        e = ConsensusDWFA(cfg)
+        for r in wc_reads:
+            e.add_sequence(r)
+        engines.append(e)
+    want = engines[0].consensus()
+    got = engines[1].consensus()
+    assert [(c.sequence, c.scores) for c in want] == [
+        (c.sequence, c.scores) for c in got
+    ]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_priority_chain_fuzz(seed):
+    """Two-level chains with a level-1 split: the priority engine's
+    worklist + shared-scorer views against the oracle."""
+    from waffle_con_tpu.models.priority_consensus import (
+        PriorityConsensusDWFA,
+    )
+
+    rng = np.random.default_rng(13000 + seed)
+    n = int(rng.integers(6, 12))
+    l0_len = int(rng.integers(40, 120))
+    l1_len = int(rng.integers(60, 160))
+    er = float(rng.choice([0.0, 0.02]))
+    t0, level0 = generate_test(4, l0_len, n, er, seed=14000 + seed)
+    t1a, _ = generate_test(4, l1_len, 1, 0.0, seed=15000 + seed)
+    t1b = bytearray(t1a)
+    t1b[l1_len // 2] = (t1b[l1_len // 2] + 1) % 4
+    t1b = bytes(t1b)
+    chains = []
+    for i in range(n):
+        lvl1 = corrupt(
+            t1a if i < n // 2 else t1b,
+            er,
+            np.random.default_rng(16000 + seed * 32 + i),
+        )
+        chains.append([level0[i], lvl1])
+    engines = []
+    for backend in ("python", "jax"):
+        e = PriorityConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=2)
+        )
+        for c in chains:
+            e.add_sequence_chain(c)
+        engines.append(e)
+    assert engines[0].consensus() == engines[1].consensus()
