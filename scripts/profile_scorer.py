@@ -31,7 +31,9 @@ def main():
     calls = 0
     while True:
         t0 = time.perf_counter()
-        steps, code, app = sc.run_extend(h, cons, 10**9, mc, False, chunk)
+        steps, code, app, _stats, _recs = sc.run_extend(
+            h, cons, 10**9, 2**31 - 1, 0, mc, False, chunk
+        )
         dt = time.perf_counter() - t0
         calls += 1
         cons += app

@@ -28,7 +28,9 @@ t0 = time.perf_counter()
 s = sc.push(h, truth[:2])
 print(f"second push {time.perf_counter()-t0:.3f}s", flush=True)
 t0 = time.perf_counter()
-steps, code, app = sc.run_extend(h, truth[:2], 10**9, 4, False, 100)
+steps, code, app, _stats, _recs = sc.run_extend(
+    h, truth[:2], 10**9, 2**31 - 1, 0, 4, False, 100
+)
 print(
     f"first run_extend (compile) {time.perf_counter()-t0:.1f}s "
     f"steps={steps} code={code}",
@@ -36,7 +38,9 @@ print(
 )
 cons = truth[:2] + app
 t0 = time.perf_counter()
-steps, code, app = sc.run_extend(h, cons, 10**9, 4, False, 100)
+steps, code, app, _stats, _recs = sc.run_extend(
+    h, cons, 10**9, 2**31 - 1, 0, 4, False, 100
+)
 print(
     f"second run_extend {time.perf_counter()-t0:.3f}s steps={steps} "
     f"code={code}",
