@@ -603,9 +603,14 @@ def _north_star_orchestrated(args) -> None:
         if gate_platform == "device"
         else ["--dual", "--reads", "16", "--len", "1500"]
     )
+    priority_scale = (
+        ["--priority"]
+        if gate_platform == "device"
+        else ["--priority", "--reads", "16", "--len", "1000"]
+    )
     for mode, label, budget_need in (
         (dual_scale, "dual", 300),
-        (["--priority"], "priority", 240),
+        (priority_scale, "priority", 240),
     ):
         if _remaining() - 20 < budget_need:
             extras[label] = "skipped (budget)"
