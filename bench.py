@@ -594,7 +594,7 @@ def _north_star_orchestrated(args) -> None:
         _BEST["parity_gate"] = {"skipped": gate_msg}
 
     # budget permitting, record dual + priority evidence (VERDICT r3 #2);
-    # the jax-on-CPU fallback runs the dual engine at a reduced scale (the
+    # the jax-on-CPU fallback runs BOTH extras at reduced scales (the
     # arena kernel's per-iteration compute is sized for a TPU VPU, not a
     # serial CPU core)
     extras = {}

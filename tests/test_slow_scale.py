@@ -72,3 +72,40 @@ def test_dual_scale_parity():
     got = engine.consensus()
     assert got == cpu
     assert got[0].is_dual()
+
+
+@pytest.mark.slow
+def test_dual_locked_tail_scale_parity():
+    """Different-length haplotypes at scale: the longer side keeps
+    extending after the shorter finishes and locks — the record
+    absorption + one-side-locked run path vs the C++ engine."""
+    num_reads, seq_len, tail_len, er = 16, 2500, 500, 0.01
+    rng = np.random.default_rng(5)
+    truth, reads1 = generate_test(4, seq_len, num_reads // 2, er, seed=5)
+    tail, _ = generate_test(4, tail_len, 1, 0.0, seed=6)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    h2 = bytes(h2) + tail
+    reads = list(reads1) + [
+        corrupt(h2, er, np.random.default_rng(600 + i))
+        for i in range(num_reads // 2)
+    ]
+    band = 16 + int(2 * er * (seq_len + tail_len))
+    cfg = lambda b: (  # noqa: E731
+        CdwfaConfigBuilder()
+        .min_count(num_reads // 4)
+        .backend(b)
+        .initial_band(band)
+        .build()
+    )
+    cpu = native_dual_consensus(reads, config=cfg("native"))
+    eng = DualConsensusDWFA(cfg("jax"))
+    for r in reads:
+        eng.add_sequence(r)
+    got = eng.consensus()
+    assert got == cpu
+    # the scenario must actually exercise the locked-tail path: a dual
+    # result whose sides differ in length
+    assert got[0].is_dual()
+    assert len(got[0].consensus1.sequence) != len(got[0].consensus2.sequence)
