@@ -1,0 +1,96 @@
+"""Engines end-to-end at DEBUG logging.
+
+The reference CI runs ``RUST_LOG=trace cargo test``
+(``/root/reference/.github/workflows/test-ci.yml:13-14``) precisely
+because log-formatting code is executable surface — a real v0.4.3 panic
+lived inside a ``trace!`` call (``/root/reference/CHANGELOG.md:5-7``).
+These tests run every engine with the ``waffle_con_tpu`` logger at
+DEBUG and force-format every emitted record.
+"""
+
+import logging
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+    PriorityConsensusDWFA,
+)
+
+
+def _formatted_messages(caplog):
+    """Force %-formatting of every captured record (the panic-shaped
+    path): a bad format string or arg mismatch raises here."""
+    return [rec.getMessage() for rec in caplog.records]
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().min_count(1).backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def test_single_engine_debug_logging(caplog):
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = ConsensusDWFA(_cfg())
+        for seq in (b"ACGTACGT", b"ACGTACGT", b"ACCTACGT"):
+            engine.add_sequence(seq)
+        results = engine.consensus()
+    assert results[0].sequence == b"ACGTACGT"
+    msgs = _formatted_messages(caplog)
+    assert any(m.startswith("Offsets:") for m in msgs)
+    assert any(m.startswith("nodes_explored:") for m in msgs)
+
+
+def test_single_engine_offset_shift_debug_logging(caplog):
+    # all-offset inputs exercise the auto-shift debug line
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = ConsensusDWFA(_cfg(offset_compare_length=4))
+        engine.add_sequence_offset(b"ACGTACGTAA", 2)
+        engine.add_sequence_offset(b"ACGTACGTAA", 2)
+        engine.consensus()
+    msgs = _formatted_messages(caplog)
+    assert any("shifting all offsets" in m for m in msgs)
+
+
+def test_dual_engine_debug_logging(caplog):
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = DualConsensusDWFA(_cfg())
+        for seq in (b"ACGTACGT", b"ACGTACGT", b"ACTTACGT", b"ACTTACGT"):
+            engine.add_sequence(seq)
+        results = engine.consensus()
+    assert results and results[0].is_dual()
+    msgs = _formatted_messages(caplog)
+    assert any(m.startswith("Offsets:") for m in msgs)
+    assert any(m.startswith("nodes_explored:") for m in msgs)
+
+
+def test_dual_engine_empty_fallback_warning_logging(caplog):
+    # a zero per-length capacity discards every pop, draining the queue
+    # with no surviving candidate -> the engine's lone warn path
+    # (reference dual_consensus.rs:772-779) must format cleanly too
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = DualConsensusDWFA(_cfg(max_capacity_per_size=0))
+        engine.add_sequence(b"ACGT")
+        engine.add_sequence(b"ACGT")
+        results = engine.consensus()
+    assert results[0].consensus1.sequence == b""
+    msgs = _formatted_messages(caplog)
+    assert any("No consensus found" in m for m in msgs)
+
+
+def test_priority_engine_debug_logging(caplog):
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = PriorityConsensusDWFA(_cfg())
+        for chain in (
+            [b"ACGT", b"ACGTACGT"],
+            [b"ACGT", b"ACGTACGT"],
+            [b"ACTT", b"ACTTACTT"],
+            [b"ACTT", b"ACTTACTT"],
+        ):
+            engine.add_sequence_chain(chain)
+        result = engine.consensus()
+    assert len(result.consensuses) == 2
+    msgs = _formatted_messages(caplog)
+    assert any(m.startswith("Calling Dual at level") for m in msgs)

@@ -623,6 +623,7 @@ class DualConsensusDWFA:
                                 lock1=node.lock1,
                                 lock2=node.lock2,
                                 allow_records=allow_recs,
+                                rec_min=full_min_count,
                             )
                             # replay absorbed reached-state records in
                             # commit order — the exact _finalize +

@@ -895,12 +895,15 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     stretches cost one host round-trip per *event*, not ~5 dispatches per
     appended base.
 
-    ``params`` is ``[16] int32`` — (slot_a, slot_b, me_budget, other_cost,
+    ``params`` is ``[17] int32`` — (slot_a, slot_b, me_budget, other_cost,
     other_len, min_count, dual_max_ed_delta, imb_min, l2, weighted,
-    max_steps, off0a, off0b, lock1, lock2, allow_records) — packed into
-    a single host upload (``allow_records``: see ``_j_run``; here the
-    host condition is every read active on at least one side under
-    early termination).
+    max_steps, off0a, off0b, lock1, lock2, allow_records, rec_min) —
+    packed into a single host upload (``allow_records``: see ``_j_run``;
+    here the host condition is every read active on at least one side
+    under early termination).  ``rec_min`` is the host's
+    ``full_min_count`` (``max(min_count, ceil(min_af * n))``): the
+    record-acceptance imbalance threshold, which only shrinks the
+    running budget when the host would also have accepted the record.
     """
     ha = params[0]
     hb = params[1]
@@ -918,6 +921,7 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     lock_a = params[13].astype(bool)
     lock_b = params[14].astype(bool)
     allow_records = params[15].astype(bool)
+    rec_min = params[16]
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
@@ -1053,7 +1057,7 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         fin_total = jnp.where(any_act, jnp.where(side0, fc1, fc2), 0).sum()
         count0 = (side0 & any_act).sum()
         count1 = any_act.sum() - count0
-        rec_imbalanced = (count0 < min_count) | (count1 < min_count)
+        rec_imbalanced = (count0 < rec_min) | (count1 < rec_min)
         fin_cost_ovf = l2 & (
             jnp.maximum(
                 jnp.where(acta, fin1_j, 0).max(),
@@ -1484,7 +1488,14 @@ def _j_arena(
         # the survivors — the host replays the removal from the history.
         # With the history full the arena stops 4 instead and the host
         # performs the discard at its own re-pop.
-        discard_now = ~rest_wins & ~arena_empty & discarded & (
+        # ~first is semantically a no-op (the engine pre-checks the
+        # in-hand pop's discard conditions before engaging the arena) but
+        # hardens against a caller violating that invariant: replaying a
+        # queue removal for an already-removed entry would corrupt the
+        # tracker counts.  The paired `first` arm in the code selection
+        # below stops the loop instead (code 4, nothing committed), so
+        # the host re-pops and performs the discard itself.
+        discard_now = ~first & ~rest_wins & ~arena_empty & discarded & (
             nsteps < step_limit
         )
         code = jnp.where(
@@ -1492,7 +1503,7 @@ def _j_arena(
             3,
             jnp.where(
                 discarded,
-                jnp.where(nsteps >= step_limit, 4, 0),
+                jnp.where(first | (nsteps >= step_limit), 4, 0),
                 jnp.where(
                     reach[win],
                     2,
@@ -2021,8 +2032,12 @@ class JaxScorer(WavefrontScorer):
             ]
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
+        # the bundled snapshot from root() is only valid for the empty
+        # consensus; a push on the handle invalidates it, but guard the
+        # consensus length too so stats(h, non_empty) can never be served
+        # the root snapshot
         cached = getattr(self, "_root_stats", None)
-        if cached is not None and cached[0] == h:
+        if cached is not None and cached[0] == h and len(consensus) == 0:
             self._root_stats = None
             return self._stats_np(jax.device_get(cached[1]))
         self.counters["stats_calls"] += 1
@@ -2187,6 +2202,7 @@ class JaxScorer(WavefrontScorer):
         lock1: bool = False,
         lock2: bool = False,
         allow_records: bool = True,
+        rec_min: int | None = None,
     ):
         """Device-side dual-node extension (both branches step together,
         with on-device divergence pruning); returns ``(steps, stop_code,
@@ -2223,6 +2239,7 @@ class JaxScorer(WavefrontScorer):
                 int(lock1),
                 int(lock2),
                 int(allow_records),
+                min_count if rec_min is None else rec_min,
             ],
             dtype=np.int32,
         )
