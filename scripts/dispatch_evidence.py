@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Count blocking device dispatches for the dual/priority evidence
+workloads on the jax-CPU backend (dispatch count is platform-invariant;
+wall time on the tunneled TPU ~= dispatches x ~80 ms + exec — see
+evidence/DUAL_DISPATCH_r04.json).
+
+Usage: python scripts/dispatch_evidence.py [--dual R L] [--priority R L]
+Prints one JSON line per requested workload.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # reliable CPU pin (see bench.py)
+
+import numpy as np
+
+DISPATCH_KEYS = (
+    "push_calls", "run_calls", "stats_calls", "clone_calls",
+    "clone_push_calls", "activate_calls", "finalize_calls",
+    "arena_calls", "run_dual_calls", "deactivate_calls",
+)
+
+
+def _cfg(backend, min_count, band):
+    from waffle_con_tpu import CdwfaConfigBuilder
+
+    return (
+        CdwfaConfigBuilder()
+        .min_count(min_count)
+        .backend(backend)
+        .initial_band(band)
+        .build()
+    )
+
+
+def dual_workload(num_reads, seq_len, error_rate=0.01):
+    from waffle_con_tpu.utils.example_gen import generate_test, corrupt
+
+    rng = np.random.default_rng(1)
+    truth, reads1 = generate_test(4, seq_len, num_reads // 2, error_rate, seed=1)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=3, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    h2 = bytes(h2)
+    reads2 = [
+        corrupt(h2, error_rate, np.random.default_rng(100 + i))
+        for i in range(num_reads // 2)
+    ]
+    return list(reads1) + reads2
+
+
+def run_dual(num_reads, seq_len):
+    from waffle_con_tpu import DualConsensusDWFA
+    from waffle_con_tpu.native import native_dual_consensus
+
+    band = 16 + int(2 * 0.01 * seq_len)
+    min_count = max(2, num_reads // 4)
+    reads = dual_workload(num_reads, seq_len)
+    cpp_start = time.perf_counter()
+    cpp = native_dual_consensus(reads, config=_cfg("native", min_count, band))
+    cpp_wall = time.perf_counter() - cpp_start
+
+    def once():
+        eng = DualConsensusDWFA(_cfg("jax", min_count, band))
+        for r in reads:
+            eng.add_sequence(r)
+        return eng, eng.consensus()
+
+    eng, res = once()  # warm-up/compile
+    t0 = time.perf_counter()
+    eng, res = once()
+    wall = time.perf_counter() - t0
+    c = eng.last_search_stats["scorer_counters"]
+    return {
+        "metric": f"dual_{num_reads}x{seq_len}_jaxcpu",
+        "parity": bool(res == cpp),
+        "jax_cpu_wall_s": round(wall, 3),
+        "cpp_wall_s": round(cpp_wall, 4),
+        "blocking_dispatches": sum(c.get(k, 0) for k in DISPATCH_KEYS),
+        "counters": {
+            k: v
+            for k, v in sorted(c.items())
+            if v and (k in DISPATCH_KEYS or k.startswith("arena"))
+        },
+    }
+
+
+def run_priority(num_reads, seq_len):
+    from waffle_con_tpu import PriorityConsensusDWFA
+    from waffle_con_tpu.native import native_priority_consensus
+    from waffle_con_tpu.utils.example_gen import generate_test, corrupt
+
+    band = 16 + int(2 * 0.01 * seq_len)
+    min_count = max(2, num_reads // 4)
+    truth, level0 = generate_test(4, seq_len // 2, num_reads, 0.01, seed=3)
+    t1a, _ = generate_test(4, seq_len, 1, 0.0, seed=4)
+    t1b = bytearray(t1a)
+    t1b[seq_len // 3] = (t1b[seq_len // 3] + 1) % 4
+    t1b[2 * seq_len // 3] = (t1b[2 * seq_len // 3] + 2) % 4
+    t1b = bytes(t1b)
+    chains = []
+    for i in range(num_reads):
+        level1_truth = t1a if i < num_reads // 2 else t1b
+        lvl1 = corrupt(level1_truth, 0.01, np.random.default_rng(200 + i))
+        chains.append([level0[i], lvl1])
+
+    cpp_start = time.perf_counter()
+    cpp = native_priority_consensus(chains, config=_cfg("native", min_count, band))
+    cpp_wall = time.perf_counter() - cpp_start
+
+    def once():
+        eng = PriorityConsensusDWFA(_cfg("jax", min_count, band))
+        for ch in chains:
+            eng.add_sequence_chain(ch)
+        return eng, eng.consensus()
+
+    eng, res = once()
+    t0 = time.perf_counter()
+    eng, res = once()
+    wall = time.perf_counter() - t0
+    c = eng.last_search_stats["scorer_counters"]
+    return {
+        "metric": f"priority_{num_reads}x{seq_len}_jaxcpu",
+        "parity": bool(res == cpp),
+        "jax_cpu_wall_s": round(wall, 3),
+        "cpp_wall_s": round(cpp_wall, 4),
+        "blocking_dispatches": sum(c.get(k, 0) for k in DISPATCH_KEYS),
+        "counters": {
+            k: v
+            for k, v in sorted(c.items())
+            if v and (k in DISPATCH_KEYS or k.startswith("arena"))
+        },
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dual", nargs=2, type=int, default=None)
+    parser.add_argument("--priority", nargs=2, type=int, default=None)
+    args = parser.parse_args()
+
+    from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    if args.dual:
+        print(json.dumps(run_dual(*args.dual)), flush=True)
+    if args.priority:
+        print(json.dumps(run_priority(*args.priority)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

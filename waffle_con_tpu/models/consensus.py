@@ -30,7 +30,6 @@ from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
     WavefrontScorer,
-    find_activation_offset,
     make_scorer,
 )
 from waffle_con_tpu.utils.pqueue import PQueueTracker, SetPriorityQueue
@@ -175,26 +174,43 @@ def replay_run_bookkeeping(
     return farthest, last_constraint
 
 
-def replay_arena_history(hist, lens, kinds, trackers, far, lcon, cfg, on_length=None):
+def replay_arena_history(
+    events, lens, kinds, trackers, far, lcon, cfg, creations=None,
+    on_length=None,
+):
     """Replay a device arena's committed interleaved pop sequence onto the
     real tracker objects — the ONE copy of the per-pop bookkeeping both
     engines' arena paths share (mirrors the engines' pop order: constrict
     every kind, remove, process, insert; the in-hand first pop was
     already constricted and removed before the arena engaged).
 
-    ``lens``/``far``/``lcon`` are mutated in place (``lens`` per node;
-    ``far``/``lcon`` per kind, matching ``trackers``).  A negative
-    history entry ``-(node + 1)`` is an on-device DISCARDED pop: its
-    queue removal is replayed but nothing else (the engine's
-    ignored-pop path — no process/insert/farthest/constraint)."""
-    for i, which in enumerate(hist):
-        which = int(which)
-        disc = which < 0
-        if disc:
-            which = -which - 1
+    ``events`` is the typed stream from ``run_arena``:
+
+    - ``("commit", n)`` — a committed extension pop of node ``n``
+      (remove, process, insert at length + 1).
+    - ``("discard", n)`` — an on-device discarded pop: its queue removal
+      is replayed but nothing else (the engine's ignored-pop path).
+    - ``("split", n)`` — node ``n``'s pop was consumed by on-device
+      child creation: remove + process, NO insert (the node dies; its
+      children's inserts follow as their own events).
+    - ``("create", j)`` — creation record ``j`` (see ``creations``):
+      registers the child at node index ``len(lens)`` and replays its
+      tracker insert.  Not a pop — no constriction.
+
+    ``lens``/``kinds`` are mutated in place and GROW as children are
+    registered; ``far``/``lcon`` are per kind, matching ``trackers``."""
+    first_pop = True
+    for kind, arg in events:
+        if kind == "create":
+            rec = creations[arg]
+            lens.append(rec["created_len"])
+            kinds.append(rec["kind"])
+            trackers[rec["kind"]].insert(rec["created_len"])
+            continue
+        which = arg
         k = kinds[which]
         length = lens[which]
-        if i > 0:
+        if not first_pop:
             for kk in range(len(trackers)):
                 while (
                     len(trackers[kk]) > cfg.max_queue_size
@@ -203,37 +219,48 @@ def replay_arena_history(hist, lens, kinds, trackers, far, lcon, cfg, on_length=
                     trackers[kk].increment_threshold()
                     lcon[kk] = 0
             trackers[k].remove(length)
-        if disc:
+        first_pop = False
+        if kind == "discard":
             continue
         far[k] = max(far[k], length)
         lcon[k] += 1
         trackers[k].process(length)
-        trackers[k].insert(length + 1)
+        if kind == "commit":
+            trackers[k].insert(length + 1)
+            lens[which] += 1
         if on_length is not None:
             on_length(length)
-        lens[which] += 1
 
 
 def requeue_arena_nodes(
-    pqueue, nodes, taken, node_steps, hist, cost, on_duplicate, alive=None
+    pqueue, nodes, taken, node_steps, events, cost, on_duplicate,
+    alive=None, n_live=None,
 ):
     """Re-queue arena participants preserving insertion order: extended
     nodes re-enter in the order of their LAST arena pop (later pop ->
-    newer insertion seq); never-popped competitors keep their original
-    seq (FIFO tie order).  ``on_duplicate(idx, node)`` handles the rare
-    key collision (drop the newcomer, undo its replayed tracker insert).
-    Nodes discarded on device (``alive[idx]`` False) are never re-queued
-    — the caller frees them."""
-    last_pop = {}
-    for i, which in enumerate(hist):
-        which = int(which)
-        if which >= 0:
-            last_pop[which] = i
+    newer insertion seq); nodes created on device enter at their
+    creation position (or their last pop if they were popped later);
+    never-popped competitors keep their original seq (FIFO tie order).
+    ``on_duplicate(idx, node)`` handles the rare key collision (drop the
+    newcomer, undo its replayed tracker insert).  Nodes discarded or
+    consumed by a split on device (``alive[idx]`` False) are never
+    re-queued — the caller frees them.  ``nodes`` must cover children
+    (indices ``n_live + j`` in creation-record order)."""
+    if n_live is None:
+        n_live = len(nodes)
+    last_pos = {}
+    n_created = 0
+    for i, (kind, arg) in enumerate(events):
+        if kind == "commit":
+            last_pos[arg] = i
+        elif kind == "create":
+            last_pos[n_live + n_created] = i
+            n_created += 1
     for i, (cand, pri, seq) in enumerate(taken, start=1):
         if node_steps[i] == 0 and (alive is None or alive[i]):
             ok = pqueue.push_restored(cand.key(), cand, pri, seq)
             check_invariant(ok, "arena restore unique")
-    for idx in sorted(last_pop, key=last_pop.get):
+    for idx in sorted(last_pos, key=last_pos.get):
         if alive is not None and not alive[idx]:
             continue
         nd = nodes[idx]
@@ -467,7 +494,12 @@ class ConsensusDWFA:
                 # it (its step 0 would stop code 2)
                 if (
                     not reached_now
-                    and len(passing_now) == 1
+                    and (
+                        len(passing_now) == 1
+                        or 2
+                        <= len(passing_now)
+                        <= getattr(scorer, "ARENA_CRE_PER_EVENT", 0)
+                    )
                     and getattr(scorer, "run_arena", None) is not None
                 ):
                     arena = self._arena_attempt(
@@ -476,9 +508,9 @@ class ConsensusDWFA:
                         farthest_consensus, last_constraint,
                     )
                     if arena is not None:
-                        (farthest_consensus, last_constraint, arena_steps,
-                         arena_ignored) = arena
-                        nodes_explored += arena_steps - arena_ignored
+                        (farthest_consensus, last_constraint,
+                         arena_explored, arena_ignored) = arena
+                        nodes_explored += arena_explored
                         nodes_ignored += arena_ignored
                         continue
                 best_other = pqueue.peek_priority()
@@ -764,8 +796,8 @@ class ConsensusDWFA:
         me_budget = (
             int(maximum_error) if maximum_error != math.inf else 2**31 - 1
         )
-        (hist, nsteps, _code, _stop_node, node_steps, appended,
-         sides_stats, _sides_act, alive) = scorer.run_arena(
+        (events, nsteps, _code, _stop_node, node_steps, appended,
+         sides_stats, _sides_act, alive, creations) = scorer.run_arena(
             [(nd.handle, None, len(nd.consensus), 0) for nd in nodes],
             me_budget,
             cfg.min_count,
@@ -782,28 +814,51 @@ class ConsensusDWFA:
             np.stack([lc, zeros]),
             np.stack([pc, zeros]),
             np.asarray(tr_scalars, dtype=np.int32),
+            create_mode=1,  # singles only: this engine has no dual nodes
         )
         if nsteps == 0:
             restore_all()
             return None
 
+        n_live = len(nodes)
         for i, nd in enumerate(nodes):
             if node_steps[i] > 0 or not alive[i]:
                 self._drop_prefetch(scorer, nd)
 
         # exact tracker replay of the committed interleaved pop sequence
+        # (lens grows as on-device-created children are registered)
         lens = [len(nd.consensus) for nd in nodes]
         far = [farthest_consensus]
         lcon = [last_constraint]
         replay_arena_history(
-            hist, lens, [0] * len(nodes), [tracker], far, lcon, cfg
+            events, lens, [0] * len(nodes), [tracker], far, lcon, cfg,
+            creations=creations,
         )
 
+        # apply extensions to the original nodes first (a split-consumed
+        # parent keeps its committed prefix so children can build on it)
         for i, nd in enumerate(nodes):
-            if node_steps[i] == 0 or not alive[i]:
+            if node_steps[i] == 0:
                 continue
             nd.consensus = nd.consensus + appended[2 * i]
             nd.stats = sides_stats[2 * i]
+
+        # materialize on-device-created children (mode 1: one single
+        # child per passing symbol of the consumed parent)
+        all_nodes = list(nodes)
+        for j, cre in enumerate(creations):
+            idx = n_live + j
+            parent = all_nodes[cre["parent"]]
+            child = _Node(
+                parent.consensus[: cre["created_len"] - 1]
+                + bytes([cre["sym1"]])
+                + appended[2 * idx],
+                cre["h1"],
+                list(parent.active),
+                list(parent.offsets),
+                sides_stats[2 * idx],
+            )
+            all_nodes.append(child)
 
         def on_duplicate(_idx, nd):
             # converged to an existing key: drop the newcomer and undo
@@ -813,15 +868,17 @@ class ConsensusDWFA:
             scorer.free(nd.handle)
 
         requeue_arena_nodes(
-            pqueue, nodes, taken, node_steps, hist, cost, on_duplicate,
-            alive=alive,
+            pqueue, all_nodes, taken, node_steps, events, cost,
+            on_duplicate, alive=alive, n_live=n_live,
         )
-        n_discarded = 0
-        for i, nd in enumerate(nodes):
+        for i, nd in enumerate(all_nodes):
             if not alive[i]:
                 scorer.free(nd.handle)
-                n_discarded += 1
-        return far[0], lcon[0], int(nsteps), n_discarded
+        explored = sum(
+            1 for k, _ in events if k in ("commit", "split")
+        )
+        ignored = sum(1 for k, _ in events if k == "discard")
+        return far[0], lcon[0], explored, ignored
 
     def _nominate(self, scorer: WavefrontScorer, node: _Node) -> List[int]:
         """Passing extension symbols for a node — a pure function of its
@@ -915,9 +972,9 @@ class ConsensusDWFA:
     ) -> None:
         check_invariant(not node.active[seq_index], "activating an already-active read")
         cfg = self.config
-        offset = find_activation_offset(
+        offset = scorer.best_activation_offset(
             node.consensus,
-            self.sequences[seq_index],
+            seq_index,
             cfg.offset_window,
             cfg.offset_compare_length,
             cfg.wildcard,

@@ -691,12 +691,16 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         passing = has_votes & (counts >= thr)
         npass = passing.sum()
 
-        all_onehot = (voters.sum(axis=1) <= 1).all()
+        # exactness: dyadic tip splits make the f32 fold bit-equal to
+        # the host f64 fold (see _dual_votes); only 3-tip reads break it
+        all_exact = (
+            jnp.where(split > 0, (split & (split - 1)) == 0, True)
+        ).all()
         near_tie = (
             (jnp.abs(maxc - min_count_f) < EPS)
             | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
         )
-        ambiguous = ~all_onehot & near_tie
+        ambiguous = ~all_exact & near_tie
         dirty = ambiguous | (npass != 1) | (n_cands == 0) | cost_overflow
 
         # early-termination runs freeze a reached read rather than ending
@@ -834,8 +838,10 @@ def _dual_votes(occ, split, w, wc, weighted):
     wildcard column is dropped whenever another candidate exists.
 
     Returns ``(counts[A] f32, has_votes[A], n_cands, exactable)`` where
-    ``exactable`` means every voting read is single-tip (so with the
-    non-weighted {0, 0.5, 1} weight lattice the f32 sums are exact)."""
+    ``exactable`` means every voting read's tip split is a power of two
+    (``1/split`` then dyadic, so the unweighted f32 sums are EXACT and
+    bit-equal to the host's f64 fold — equality decisions included;
+    only 3-tip reads break this)."""
     voting = (w > 0) & (split > 0)
     voters = (occ > 0) & voting[:, None]
     frac = jnp.where(
@@ -852,21 +858,30 @@ def _dual_votes(occ, split, w, wc, weighted):
     has_votes = jnp.where(drop_wc, has_votes.at[wc_col].set(False), has_votes)
     counts = jnp.where(drop_wc, counts.at[wc_col].set(0.0), counts)
     n_cands = has_votes.sum()
-    exactable = (
-        jnp.where(voting, (occ > 0).sum(axis=1), 0) <= 1
-    ).all() & ~weighted
+    dyadic = (split & (split - 1)) == 0
+    exactable = jnp.where(voting, dyadic, True).all() & ~weighted
     return counts, has_votes, n_cands, exactable
 
 
 @partial(
     jax.jit, static_argnames=("num_symbols", "uniform"), donate_argnums=(0,)
 )
-def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
-                uniform):
+def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
+                wc, et, num_symbols, uniform):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
     with divergence pruning (``dual_max_ed_delta``) applied on device
     exactly as the host would (integer compares on post-push distances).
+
+    ``mc_tab`` (``[R+1] int32``) and ``imb_tab`` (``[T] int32``) carry
+    the host's exact dynamic-min-count arithmetic for ``min_af != 0``
+    (``/root/reference/src/dual_consensus.rs:326-336,497-513``):
+    ``mc_tab[n]`` is ``max(min_count, ceil(min_af * n))`` for a side
+    with ``n`` voting reads (the per-side nomination threshold), and
+    ``imb_tab[L]`` is the host's ``active_min_count[L]`` (activation
+    points are known up front, so the whole table is precomputable) for
+    the imbalance check at node length ``L``.  With ``min_af == 0`` both
+    tables are constant ``min_count`` and the behavior is unchanged.
 
     ``uniform`` (static) selects slice- vs gather-sourced read windows
     (see ``_j_run``); ``params[11]``/``params[12]`` carry each side's
@@ -895,9 +910,10 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     stretches cost one host round-trip per *event*, not ~5 dispatches per
     appended base.
 
-    ``params`` is ``[17] int32`` — (slot_a, slot_b, me_budget, other_cost,
+    ``params`` is ``[18] int32`` — (slot_a, slot_b, me_budget, other_cost,
     other_len, min_count, dual_max_ed_delta, imb_min, l2, weighted,
-    max_steps, off0a, off0b, lock1, lock2, allow_records, rec_min) —
+    max_steps, off0a, off0b, lock1, lock2, allow_records, rec_min,
+    mc_dyn) —
     packed into a single host upload (``allow_records``: see ``_j_run``;
     here the host condition is every read active on at least one side
     under early termination).  ``rec_min`` is the host's
@@ -912,7 +928,9 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     other_len = params[4]
     min_count = params[5]
     delta = params[6]
-    imb_min = params[7]
+    # params[7] (imb_min) is consumed host-side only: the wrapper builds
+    # the fallback imb_tab from it; every kernel imbalance check reads
+    # the table
     l2 = params[8].astype(bool)
     weighted = params[9].astype(bool)
     max_steps = params[10]
@@ -922,13 +940,15 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     lock_b = params[14].astype(bool)
     allow_records = params[15].astype(bool)
     rec_min = params[16]
+    mc_dyn = params[17].astype(bool)
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
     offa = state["off"][ha]
     offb = state["off"][hb]
     EPS = VOTE_EPS
-    min_count_f = min_count.astype(jnp.float32)
+    MCN = mc_tab.shape[0]
+    IMBN = imb_tab.shape[0]
 
     def stats_at(D, e, rmin, er, off, act, clen, off0):
         if uniform:
@@ -996,16 +1016,27 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
             counts, has_votes, n_cands, exactable = _dual_votes(
                 occ, split, w, wc, weighted
             )
+            # per-side dynamic min count: the host's vote-total form.
+            # The integer table index is only the host's arithmetic when
+            # the surviving-vote total IS integer (wildcard-tip drops
+            # can leave fractional totals) — otherwise, with a dynamic
+            # table, the decision must bounce to the host
+            n_vote_f = counts.sum()
+            n_vote = jnp.round(n_vote_f).astype(jnp.int32)
+            int_ok = jnp.abs(n_vote_f - jnp.round(n_vote_f)) < EPS
+            tab_bad = mc_dyn & ~int_ok
+            exactable = exactable & ~tab_bad
+            mc_f = mc_tab[jnp.clip(n_vote, 0, MCN - 1)].astype(jnp.float32)
             maxc = jnp.where(has_votes, counts, -1.0).max()
-            thr = jnp.minimum(min_count_f, maxc)
+            thr = jnp.minimum(mc_f, maxc)
             passing = has_votes & (counts >= thr)
             npass = passing.sum()
             near_tie = (
-                (jnp.abs(maxc - min_count_f) < EPS)
+                (jnp.abs(maxc - mc_f) < EPS)
                 | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
             )
             ambiguous = ~exactable & near_tie
-            dirty = ambiguous | (npass != 1) | (n_cands == 0)
+            dirty = ambiguous | (npass != 1) | (n_cands == 0) | tab_bad
             sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(
                 jnp.int32
             )
@@ -1114,7 +1145,9 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         both2 = acta & actb
         acta2 = acta & ~(both2 & (eb2 + delta < ea2))
         actb2 = actb & ~(both2 & (ea2 + delta < eb2))
-        imb = (acta2.sum() < imb_min) | (actb2.sum() < imb_min)
+        # the next pop's imbalance check runs at the committed length
+        imb_v = imb_tab[jnp.clip(cur_len + 1, 0, IMBN - 1)]
+        imb = (acta2.sum() < imb_v) | (actb2.sum() < imb_v)
 
         commit = (code == 0) & ~ovf
         code = jnp.where(
@@ -1196,14 +1229,22 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     )
 
 
+#: creation budget of one arena call: total records, and the per-event
+#: child cap (a split event with more children than this stops for host
+#: expansion — the tail regime where dual cross products explode)
+CRE_CAP = 64
+CRE_PER_EVENT = 8
+
+
 @partial(
     jax.jit,
     static_argnames=("num_symbols", "max_steps", "K", "uniform"),
     donate_argnums=(0,),
 )
 def _j_arena(
-    state, reads, reads_pad, rlen, params, slots, kinds, seqv0, off0s,
-    tr_scalars, lc, pc, wc, et, num_symbols, max_steps, K, uniform,
+    state, reads, reads_pad, rlen, params, slots, kinds0, seqv0, off0s0,
+    tr_scalars, lc0, pc0, mc_tab, imb_tab, wc, et, num_symbols, max_steps,
+    K, uniform,
 ):
     """K-node pop ARENA: resolve the pop competition among the K best
     runnable queue entries entirely on device.
@@ -1235,13 +1276,40 @@ def _j_arena(
     original queue insertion order for FIFO tie-breaks; re-pushed nodes
     take fresh, larger ranks and lose full ties to never-popped entries.
 
-    ``params`` is ``[13] int32``: (me_budget, min_count, ed_delta,
+    ``params`` is ``[17] int32``: (me_budget, min_count, ed_delta,
     imb_min, l2, weighted, rest_cost, rest_len, n_live, max_queue_size,
-    capacity_per_size, step_limit, max_nodes_wo_constraint).
+    capacity_per_size, step_limit, max_nodes_wo_constraint, create_mode,
+    n_pool, split_relax, mc_dyn).  ``split_relax`` permits clear-margin
+    fractional-vote splits (only sound when the mc table is constant,
+    i.e. min_af == 0 — the vote-total index is undecidable otherwise).
     ``tr_scalars`` is ``[2, 4] int32``: per kind (threshold, total,
     farthest, last_constraint).  Both host constriction triggers are
     modeled on device (queue overflow and the ``max_nodes_wo_constraint``
     budget), so the host does NOT need to clamp ``step_limit``.
+
+    ON-DEVICE CHILD CREATION (``create_mode`` > 0): a winner whose votes
+    split cleanly — exact integer counts, no near-ties — no longer stops
+    the arena.  The kernel enumerates the host's exact child list
+    (``DualConsensusDWFA._build_specs`` order: singles by ascending
+    symbol, then split pairs over all non-wildcard candidates in
+    (count desc, sym) order when >= 2 candidates reach ``min_count``;
+    for dual parents the full cross product of each side's passing
+    symbols), clones + pushes each child into the next free node of the
+    host-provided creation pool (node indices ``n_live .. n_live+n_pool``
+    own real state slots), applies divergence pruning to dual children,
+    replays the tracker arithmetic (parent pop = remove + process, one
+    insert per child), and continues the pop loop with the children
+    competing.  ``create_mode`` 1 = singles only (the single engine's
+    expansion has no split pairs), 2 = singles + split pairs + dual
+    cross products (the dual engine).  Events that don't fit (children >
+    ``CRE_PER_EVENT``, pool exhausted, record buffer full, non-exact
+    votes, a finished/locked side) stop with code 1 as before — the
+    host re-derives the expansion, so absorption is purely an
+    optimization with identical semantics.
+    The history records ``2K + node`` for the consumed parent pop and
+    ``3K + j`` for creation record ``j``; records carry (parent, kind,
+    sym1, sym2, created_len), with child ``j`` living at node index
+    ``n_live + j``.
 
     Stop codes: 1 = winner needs host arbitration (votes/finished side),
     2 = winner reached its baseline end (host records the result),
@@ -1252,12 +1320,13 @@ def _j_arena(
     marked dead, history records ``K + node`` — and the loop continues
     with the survivors (the host frees dead nodes and replays their
     removals).  Returns (state, hist, n_steps, code, stop_node,
-    per-node steps, per-side stats, act, cons, clen, alive).
+    per-node steps, per-side stats, act, cons, clen, alive,
+    cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len).
     """
     me_budget = params[0]
     min_count = params[1]
     delta = params[2]
-    imb_min = params[3]
+    # params[3] (imb_min) is consumed host-side only (fallback imb_tab)
     l2 = params[4].astype(bool)
     weighted = params[5].astype(bool)
     rest_cost = params[6]
@@ -1267,17 +1336,20 @@ def _j_arena(
     cap = params[10]
     step_limit = params[11]
     max_nwc = params[12]
+    create_mode = params[13]
+    n_pool = params[14]
+    relax = params[15].astype(bool)
+    mc_dyn = params[16].astype(bool)
 
     W = state["D"].shape[2]
     E = jnp.int32((W - 2) // 2)
     C = state["cons"].shape[1]
-    Lw = lc.shape[1]
+    Lw = lc0.shape[1]
     R = reads.shape[0]
+    A = num_symbols
+    n_lim = n_live + n_pool          # nodes beyond this are pure scratch
 
-    offs = state["off"][slots]       # [2K, R]
-    live = jnp.arange(K) < n_live    # [K]
-
-    def stats_all(D, e, rmin, er, act, clen):
+    def stats_all(D, e, rmin, er, offs, act, clen, off0s):
         """Per-side snapshots [2K, ...]; with ``uniform`` (static) the 2K
         read windows are unrolled ``dynamic_slice``s of ``reads_pad``
         (each side's active reads share offset ``off0s[side]``) instead
@@ -1313,28 +1385,40 @@ def _j_arena(
         return _col_step(
             D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
         )
-    is_dual = kinds == 1             # [K]
-    min_count_f = min_count.astype(jnp.float32)
     EPS = VOTE_EPS
     BIGTOT = jnp.int32(2**31 - 1)
+    MCN = mc_tab.shape[0]
+    IMBN = imb_tab.shape[0]
 
     def nominate(occ, split, w):
-        """Vote fold + decision for one side; returns (dirty, sym)."""
+        """Vote fold + decision for one side; returns
+        (dirty, sym, counts, has_votes, exactable, mc)."""
         counts, has_votes, n_cands, exactable = _dual_votes(
             occ, split, w, wc, weighted
         )
+        # per-side dynamic min count (host vote-total form; constant
+        # min_count when min_af == 0).  A fractional surviving-vote
+        # total (wildcard-tip drops) cannot index the integer table, so
+        # with a dynamic table those decisions bounce to the host
+        n_vote_f = counts.sum()
+        n_vote = jnp.round(n_vote_f).astype(jnp.int32)
+        int_ok = jnp.abs(n_vote_f - jnp.round(n_vote_f)) < EPS
+        tab_bad = mc_dyn & ~int_ok
+        exactable = exactable & ~tab_bad
+        mc = mc_tab[jnp.clip(n_vote, 0, MCN - 1)]
+        mc_f = mc.astype(jnp.float32)
         maxc = jnp.where(has_votes, counts, -1.0).max()
-        thr = jnp.minimum(min_count_f, maxc)
+        thr = jnp.minimum(mc_f, maxc)
         passing = has_votes & (counts >= thr)
         npass = passing.sum()
         near_tie = (
-            (jnp.abs(maxc - min_count_f) < EPS)
+            (jnp.abs(maxc - mc_f) < EPS)
             | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
         )
         ambiguous = ~exactable & near_tie
-        dirty = ambiguous | (npass != 1) | (n_cands == 0)
+        dirty = ambiguous | (npass != 1) | (n_cands == 0) | tab_bad
         sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(jnp.int32)
-        return dirty, sym
+        return dirty, sym, counts, has_votes, exactable, mc, near_tie
 
     def node_eval(dual, off2, act2, eds2, occ2, split2, reached2, clen2):
         """Per-node decision inputs; side axes are [2, ...]."""
@@ -1387,21 +1471,38 @@ def _j_arena(
         w2 = jnp.where(
             use_w & both, c1f / denom, jnp.where(a2, 1.0, 0.0)
         )
-        dirty1, sym1 = nominate(occ2[0], split2[0], w1)
-        dirty2, sym2 = nominate(occ2[1], split2[1], w2)
+        (dirty1, sym1, cnt1, hv1, ex1, mc1, nt1) = nominate(
+            occ2[0], split2[0], w1
+        )
+        (dirty2, sym2, cnt2, hv2, ex2, mc2, nt2) = nominate(
+            occ2[1], split2[1], w2
+        )
         dirty = jnp.where(
             dual, dirty1 | dirty2 | fin1 | fin2, dirty1
         ) | cost_ovf
-        imb = dual & ((a1.sum() < imb_min) | (a2.sum() < imb_min))
-        return total, nlen, reach_stop, dirty, sym1, sym2, imb
+        imb_v = imb_tab[jnp.clip(nlen, 0, IMBN - 1)]
+        imb = dual & ((a1.sum() < imb_v) | (a2.sum() < imb_v))
+        return (
+            total, nlen, reach_stop, dirty, sym1, sym2, imb,
+            fin1, fin2, cost_ovf,
+            jnp.stack([cnt1, cnt2]), jnp.stack([hv1, hv2]),
+            jnp.stack([ex1, ex2]), jnp.stack([mc1, mc2]),
+            jnp.stack([nt1, nt2]),
+        )
 
     def body(carry):
-        (D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
-         nsteps, seqv, fresh, alive, seq_ctr, _code, _stop_node) = carry
+        (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+         lc, pc, tr, steps, hist, nsteps, seqv, fresh, alive, seq_ctr,
+         pool_next, cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2,
+         cre_len, _diag, _code, _stop_node) = carry
 
-        eds, occ, split, reached = stats_all(D, e, rmin, er, act, clen)
+        is_dual = kinds == 1
+        eds, occ, split, reached = stats_all(
+            D, e, rmin, er, offs, act, clen, off0s
+        )
 
-        totals, lens, reach, dirty, sym1s, sym2s, imb = jax.vmap(node_eval)(
+        (totals, lens, reach, dirty, sym1s, sym2s, imb, fin1s, fin2s,
+         covfs, cstk, hvstk, exstk, mcstk, ntstk) = jax.vmap(node_eval)(
             is_dual,
             offs.reshape(K, 2, R),
             act.reshape(K, 2, R),
@@ -1411,7 +1512,7 @@ def _j_arena(
             reached.reshape(K, 2, R),
             clen.reshape(K, 2),
         )
-        totals = jnp.where(live & alive, totals, BIGTOT)
+        totals = jnp.where(alive & (kinds >= 0), totals, BIGTOT)
 
         # ---- pop-winner tournament: host priority is (-cost, len) with
         # FIFO (smaller seq rank) on full ties
@@ -1498,6 +1599,97 @@ def _j_arena(
         discard_now = ~first & ~rest_wins & ~arena_empty & discarded & (
             nsteps < step_limit
         )
+
+        # ---- on-device child creation decision (see docstring): a
+        # clean vote split becomes a batch of child nodes competing in
+        # the arena instead of a stop
+        wk_single = kinds[win] == 0
+        cA = cstk[win, 0]
+        cB = cstk[win, 1]
+        hvA = hvstk[win, 0]
+        hvB = hvstk[win, 1]
+        exA = exstk[win, 0]
+        exB = exstk[win, 1]
+        ntA = ntstk[win, 0]
+        ntB = ntstk[win, 1]
+        sym_idx = jnp.arange(A, dtype=jnp.int32)
+        mcA_f = mcstk[win, 0].astype(jnp.float32)
+        mcB_f = mcstk[win, 1].astype(jnp.float32)
+        maxA = jnp.where(hvA, cA, -1.0).max()
+        passA = hvA & (cA >= jnp.minimum(mcA_f, maxA))
+        maxB = jnp.where(hvB, cB, -1.0).max()
+        passB = hvB & (cB >= jnp.minimum(mcB_f, maxB))
+        nA = passA.sum()
+        nB = passB.sum()
+        # split pairs (single parents): all non-wildcard candidates in
+        # (count desc, sym asc) order, gated on >= 2 candidates reaching
+        # the side's dynamic min count (host _build_specs semantics;
+        # symtab is sorted, so dense-id order == byte order)
+        wc_mask = (wc >= 0) & (sym_idx == jnp.maximum(wc, 0))
+        cand_nw = hvA & ~wc_mask
+        ncand = cand_nw.sum()
+        npass_mc = (cand_nw & (cA >= mcA_f)).sum()
+        n_pairs = jnp.where(
+            (create_mode >= 2) & (npass_mc > 1),
+            ncand * (ncand - 1) // 2,
+            0,
+        )
+        n_children = jnp.where(wk_single, nA + n_pairs, nA * nB)
+        # vote-decision safety: exact single-tip integer counts, OR
+        # (``relax``: min_af == 0, so the mc-table index is moot)
+        # fractional counts whose every comparison the f32 fold decides
+        # with margin > EPS — the same contract the commit path uses —
+        # including the pairwise ordering margins the split-pair
+        # enumeration needs (equal-count ties are only safe when exact)
+        mcmargA = jnp.where(hvA, jnp.abs(cA - mcA_f) > EPS, True).all()
+        mcmargB = jnp.where(hvB, jnp.abs(cB - mcB_f) > EPS, True).all()
+        dmat = jnp.abs(cA[:, None] - cA[None, :])
+        pairm = (
+            cand_nw[:, None]
+            & cand_nw[None, :]
+            & (sym_idx[:, None] != sym_idx[None, :])
+        )
+        pair_ok = jnp.where(pairm, dmat > EPS, True).all()
+        relaxA = relax & ~ntA & mcmargA
+        relaxB = relax & ~ntB & mcmargB
+        # count-ordering margins only matter where split pairs can be
+        # enumerated (mode >= 2); mode 1 emits singles by symbol order
+        ord_ok = pair_ok | (create_mode < 2)
+        exact_ok = jnp.where(
+            wk_single,
+            exA | (relaxA & ord_ok),
+            (exA | relaxA) & (exB | relaxB),
+        )
+        kind_ok = wk_single | (
+            (create_mode >= 2) & ~fin1s[win] & ~fin2s[win]
+        )
+        splitable = (
+            (create_mode >= 1)
+            & exact_ok
+            & kind_ok
+            & ~covfs[win]
+            & (n_children >= 2)
+            & (n_children <= CRE_PER_EVENT)
+            & (pool_next + n_children <= n_lim)
+            & (cre_count + n_children <= CRE_CAP)
+            & (nsteps + 1 + n_children <= step_limit)
+        )
+        want_split = (
+            dirty[win] & splitable & ~reach[win] & ~discarded
+            & ~rest_wins & ~arena_empty
+        )
+        # stop diagnostics (read by the host at code-1 stops): why the
+        # winner's split was not absorbed — packed flags + child count
+        stop_diag = (
+            n_children * 64
+            + exact_ok.astype(jnp.int32)
+            + kind_ok.astype(jnp.int32) * 2
+            + (n_children <= CRE_PER_EVENT).astype(jnp.int32) * 4
+            + (pool_next + n_children <= n_lim).astype(jnp.int32) * 8
+            + (cre_count + n_children <= CRE_CAP).astype(jnp.int32) * 16
+            + (nsteps + 1 + n_children <= step_limit).astype(jnp.int32) * 32
+        )
+
         code = jnp.where(
             rest_wins | arena_empty,
             3,
@@ -1508,13 +1700,240 @@ def _j_arena(
                     reach[win],
                     2,
                     jnp.where(
-                        dirty[win],
+                        dirty[win] & ~want_split,
                         1,
                         jnp.where(nsteps >= step_limit, 4, 0),
                     ),
                 ),
             ),
         )
+
+        # ---- child creation, under lax.cond so the staged column
+        # pushes (2 per child slot) only execute on actual split events
+        p1c = 2 * win
+        p2c = p1c + 1
+        plen = lens[win]
+
+        def create_branch(op):
+            (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+             lc, tr, hist, seqv, fresh, alive,
+             pool_next, cre_count, cre_parent, cre_kind, cre_sym1,
+             cre_sym2, cre_len) = op
+            cumA = jnp.cumsum(passA.astype(jnp.int32))
+            cumB = jnp.cumsum(passB.astype(jnp.int32))
+
+            def nth(cum, mask, t_):
+                """Dense id of the (t_+1)-th passing symbol, ascending."""
+                return jnp.argmax((cum == t_ + 1) & mask).astype(jnp.int32)
+
+            # (count desc, sym asc) candidate order; valid only when
+            # counts are exact or pairwise-separated (checked above)
+            order = jnp.lexsort(
+                (sym_idx, jnp.where(cand_nw, -cA, jnp.float32(3e38)))
+            )
+            row_sz = jnp.maximum(ncand - 1 - sym_idx, 0)
+            cum_rows = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(row_sz)]
+            )
+            nB_safe = jnp.maximum(nB, 1)
+
+            def spec_at(tt):
+                """Child ``tt``'s (in_range, kind, sym1, sym2, src2) in
+                the host's exact ``_build_specs`` order."""
+                in_range = tt < n_children
+                is_sing = wk_single & (tt < nA)
+                s_sym = nth(cumA, passA, tt)
+                pp = tt - nA
+                prow = jnp.argmax(
+                    (pp >= cum_rows[:-1]) & (pp < cum_rows[1:])
+                ).astype(jnp.int32)
+                pj = prow + 1 + pp - cum_rows[jnp.clip(prow, 0, A - 1)]
+                pairA = order[jnp.clip(prow, 0, A - 1)]
+                pairB = order[jnp.clip(pj, 0, A - 1)]
+                crossA = nth(cumA, passA, tt // nB_safe)
+                crossB = nth(cumB, passB, tt % nB_safe)
+                symA = jnp.where(
+                    wk_single, jnp.where(is_sing, s_sym, pairA), crossA
+                )
+                symB = jnp.where(wk_single, pairB, crossB)
+                kind_t = jnp.where(is_sing, 0, 1).astype(jnp.int32)
+                # split children clone BOTH sides from the parent's side 1
+                src2 = jnp.where(wk_single, p1c, p2c)
+                return in_range, kind_t, symA, symB, src2
+
+            def cols_at(symA, symB, src2):
+                """Both sides' pushed columns for one child (parent rows
+                are never written by creation, so reading them from the
+                carried arrays is stable)."""
+                c1cols = col_side(
+                    D[p1c], e[p1c], rmin[p1c], er[p1c], offs[p1c],
+                    act[p1c], clen[p1c] + 1, off0s[p1c], symA,
+                )
+                c2cols = col_side(
+                    D[src2], e[src2], rmin[src2], er[src2], offs[src2],
+                    act[src2], clen[src2] + 1, off0s[src2], symB,
+                )
+                return c1cols, c2cols
+
+            # pass 1: band-overflow scan, so an overflow anywhere aborts
+            # the whole event atomically (nothing written)
+            def ovf_body(t, ovf):
+                in_range, kind_t, symA, symB, src2 = spec_at(t)
+                (_, e1n, _, _), (_, e2n, _, _) = cols_at(symA, symB, src2)
+                dual_t = kind_t == 1
+                return ovf | (
+                    in_range
+                    & (
+                        (act[p1c] & (e1n >= E)).any()
+                        | (dual_t & (act[src2] & (e2n >= E)).any())
+                    )
+                )
+
+            ovf_any = lax.fori_loop(
+                0, CRE_PER_EVENT, ovf_body, jnp.bool_(False)
+            )
+            ok = ~ovf_any
+
+            # pass 2: predicated writes (dynamic loop keeps the compiled
+            # graph small — an unrolled version of this block crashed
+            # the XLA:CPU compiler on large geometries)
+            def write_body(t, st):
+                (D, e, rmin, er, act_a, cons, clen, offs, off0s, kinds,
+                 lc, tr, hist, seqv, fresh, alive,
+                 cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len) = st
+                in_range, kind_t, symA, symB, src2 = spec_at(t)
+                do = ok & in_range
+                dual_do = do & (kind_t == 1)
+                (D1n, e1n, rmin1n, er1n), (D2n, e2n, rmin2n, er2n) = (
+                    cols_at(symA, symB, src2)
+                )
+                # divergence pruning on the fresh dual pair (host prunes
+                # children at pop-finishing time with the same rule)
+                both_t = act_a[p1c] & act_a[src2] & (kind_t == 1)
+                act1n = act_a[p1c] & ~(both_t & (e2n + delta < e1n))
+                act2n = act_a[src2] & ~(both_t & (e1n + delta < e2n))
+                c = pool_next + t
+                c1 = 2 * c
+                c2 = c1 + 1
+                sel = lambda cnd, new, old: jnp.where(cnd, new, old)  # noqa: E731
+                D = D.at[c1].set(sel(do, D1n, D[c1]))
+                e = e.at[c1].set(sel(do, e1n, e[c1]))
+                rmin = rmin.at[c1].set(sel(do, rmin1n, rmin[c1]))
+                er = er.at[c1].set(sel(do, er1n, er[c1]))
+                act_a = act_a.at[c1].set(sel(do, act1n, act_a[c1]))
+                cons = cons.at[c1].set(
+                    sel(
+                        do,
+                        cons[p1c].at[jnp.clip(clen[p1c], 0, C - 1)].set(
+                            symA
+                        ),
+                        cons[c1],
+                    )
+                )
+                clen = clen.at[c1].set(sel(do, clen[p1c] + 1, clen[c1]))
+                offs = offs.at[c1].set(sel(do, offs[p1c], offs[c1]))
+                off0s = off0s.at[c1].set(sel(do, off0s[p1c], off0s[c1]))
+                D = D.at[c2].set(sel(dual_do, D2n, D[c2]))
+                e = e.at[c2].set(sel(dual_do, e2n, e[c2]))
+                rmin = rmin.at[c2].set(sel(dual_do, rmin2n, rmin[c2]))
+                er = er.at[c2].set(sel(dual_do, er2n, er[c2]))
+                act_a = act_a.at[c2].set(sel(dual_do, act2n, act_a[c2]))
+                cons = cons.at[c2].set(
+                    sel(
+                        dual_do,
+                        cons[src2].at[
+                            jnp.clip(clen[src2], 0, C - 1)
+                        ].set(symB),
+                        cons[c2],
+                    )
+                )
+                clen = clen.at[c2].set(
+                    sel(dual_do, clen[src2] + 1, clen[c2])
+                )
+                offs = offs.at[c2].set(sel(dual_do, offs[src2], offs[c2]))
+                off0s = off0s.at[c2].set(
+                    sel(dual_do, off0s[src2], off0s[c2])
+                )
+                kinds = kinds.at[c].set(sel(do, kind_t, kinds[c]))
+                alive = alive.at[c].set(alive[c] | do)
+                seqv = seqv.at[c].set(sel(do, seq_ctr + t, seqv[c]))
+                fresh = fresh.at[c].set(fresh[c] & ~do)
+                # tracker insert: one per child, at the child's length,
+                # against the child kind's CURRENT threshold
+                nl = plen + 1
+                li_c = jnp.clip(nl, 0, Lw - 1)
+                kk = jnp.clip(kind_t, 0, 1)
+                lc = lc.at[kk, li_c].add(do.astype(jnp.int32))
+                tr = tr.at[kk, 1].add(
+                    (do & (nl >= tr[kk, 0])).astype(jnp.int32)
+                )
+                ridx = cre_count + t
+                rclip = jnp.clip(ridx, 0, CRE_CAP - 1)
+                hp = jnp.clip(nsteps + 1 + t, 0, max_steps - 1)
+                hist = hist.at[hp].set(
+                    sel(do, (3 * K + ridx).astype(hist.dtype), hist[hp])
+                )
+                cre_parent = cre_parent.at[rclip].set(
+                    sel(do, win, cre_parent[rclip])
+                )
+                cre_kind = cre_kind.at[rclip].set(
+                    sel(do, kind_t, cre_kind[rclip])
+                )
+                cre_sym1 = cre_sym1.at[rclip].set(
+                    sel(do, symA, cre_sym1[rclip])
+                )
+                cre_sym2 = cre_sym2.at[rclip].set(
+                    sel(do, symB, cre_sym2[rclip])
+                )
+                cre_len = cre_len.at[rclip].set(
+                    sel(do, nl, cre_len[rclip])
+                )
+                return (
+                    D, e, rmin, er, act_a, cons, clen, offs, off0s,
+                    kinds, lc, tr, hist, seqv, fresh, alive,
+                    cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len,
+                )
+
+            (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+             lc, tr, hist, seqv, fresh, alive,
+             cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len) = (
+                lax.fori_loop(
+                    0,
+                    CRE_PER_EVENT,
+                    write_body,
+                    (D, e, rmin, er, act, cons, clen, offs, off0s,
+                     kinds, lc, tr, hist, seqv, fresh, alive,
+                     cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len),
+                )
+            )
+            n_made = jnp.where(ok, n_children, 0)
+            pool_next = pool_next + n_made
+            cre_count = cre_count + n_made
+            return (
+                (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+                 lc, tr, hist, seqv, fresh, alive,
+                 pool_next, cre_count, cre_parent, cre_kind, cre_sym1,
+                 cre_sym2, cre_len),
+                ovf_any,
+            )
+
+        def skip_branch(op):
+            return op, jnp.bool_(False)
+
+        ((D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+          lc, tr, hist, seqv, fresh, alive,
+          pool_next, cre_count, cre_parent, cre_kind, cre_sym1,
+          cre_sym2, cre_len), cre_ovf) = lax.cond(
+            want_split,
+            create_branch,
+            skip_branch,
+            (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+             lc, tr, hist, seqv, fresh, alive,
+             pool_next, cre_count, cre_parent, cre_kind, cre_sym1,
+             cre_sym2, cre_len),
+        )
+        split_commit = want_split & ~cre_ovf
+        code = jnp.where(want_split & cre_ovf, 5, code)
 
         # ---- commit: advance the winner's side(s) by its symbol(s)
         s1 = 2 * win
@@ -1538,11 +1957,13 @@ def _j_arena(
         act1n = act[s1] & ~(both2 & (e2n + delta < e1n))
         act2n = act[s2] & ~(both2 & (e1n + delta < e2n))
 
-        commit = (code == 0) & ~discard_now & ~ovf
+        commit = (code == 0) & ~discard_now & ~split_commit & ~ovf
         code = jnp.where(
             code != 0,
             code,
-            jnp.where(discard_now, 0, jnp.where(ovf, 5, 0)),
+            jnp.where(
+                discard_now | split_commit, 0, jnp.where(ovf, 5, 0)
+            ),
         )
 
         D = D.at[s1].set(jnp.where(commit, D1n, D[s1]))
@@ -1595,33 +2016,69 @@ def _j_arena(
         lc_disc = lc.at[k, li].add(-1)
         tr_disc = tr.at[k, 1].set(total_q - (wlen >= thr).astype(jnp.int32))
 
-        lc = jnp.where(
-            commit, lc.at[k].set(lc_k), jnp.where(discard_now, lc_disc, lc)
+        # split-pop bookkeeping: remove + process, NO parent insert (the
+        # child inserts were applied inside the creation branch, so the
+        # removal is ADDITIVE on top of them)
+        lc_sp = jnp.where(first, lc, lc.at[k, li].add(-1))
+        tr_sp = (
+            tr.at[k, 1]
+            .add(jnp.where(first, 0, -(wlen >= thr).astype(jnp.int32)))
+            .at[k, 2]
+            .set(jnp.maximum(far, wlen))
+            .at[k, 3]
+            .set(lcon + 1)
         )
-        pc = jnp.where(commit, pc.at[k].set(pc_k), pc)
+        pc_sp = pc.at[k, li].add(1)
+
+        lc = jnp.where(
+            commit,
+            lc.at[k].set(lc_k),
+            jnp.where(
+                discard_now, lc_disc, jnp.where(split_commit, lc_sp, lc)
+            ),
+        )
+        pc = jnp.where(
+            commit,
+            pc.at[k].set(pc_k),
+            jnp.where(split_commit, pc_sp, pc),
+        )
         tr = jnp.where(
             commit,
             tr.at[k].set(jnp.stack([thr, total_q2, far2, lcon2])),
-            jnp.where(discard_now, tr_disc, tr),
+            jnp.where(
+                discard_now, tr_disc, jnp.where(split_commit, tr_sp, tr)
+            ),
         )
 
         recorded = commit | discard_now
-        hist_val = jnp.where(discard_now, win + K, win).astype(jnp.int8)
+        hist_val = jnp.where(
+            split_commit,
+            2 * K + win,
+            jnp.where(discard_now, win + K, win),
+        ).astype(hist.dtype)
         hist = jnp.where(
-            recorded,
+            recorded | split_commit,
             hist.at[jnp.clip(nsteps, 0, max_steps - 1)].set(hist_val),
             hist,
         )
         steps = jnp.where(commit, steps.at[win].add(1), steps)
-        alive = jnp.where(discard_now, alive.at[win].set(False), alive)
-        nsteps = nsteps + recorded.astype(jnp.int32)
+        alive = jnp.where(
+            discard_now | split_commit, alive.at[win].set(False), alive
+        )
+        nsteps = nsteps + jnp.where(
+            split_commit, 1 + n_children, recorded.astype(jnp.int32)
+        )
         seqv = jnp.where(commit, seqv.at[win].set(seq_ctr), seqv)
         fresh = jnp.where(commit, fresh.at[win].set(False), fresh)
-        seq_ctr = seq_ctr + commit.astype(jnp.int32)
+        seq_ctr = seq_ctr + jnp.where(
+            split_commit, n_children, commit.astype(jnp.int32)
+        )
         stop_node = win
         return (
-            D, e, rmin, er, act, cons, clen, lc, pc, tr, steps, hist,
-            nsteps, seqv, fresh, alive, seq_ctr, code, stop_node,
+            D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+            lc, pc, tr, steps, hist, nsteps, seqv, fresh, alive, seq_ctr,
+            pool_next, cre_count, cre_parent, cre_kind, cre_sym1,
+            cre_sym2, cre_len, stop_diag, code, stop_node,
         )
 
     init = (
@@ -1632,25 +2089,40 @@ def _j_arena(
         state["act"][slots],
         state["cons"][slots],
         state["clen"][slots],
-        lc,
-        pc,
+        state["off"][slots],
+        off0s0,
+        kinds0,
+        lc0,
+        pc0,
         tr_scalars,
         jnp.zeros((K,), jnp.int32),
-        jnp.zeros((max_steps,), jnp.int8),
+        jnp.zeros((max_steps,), jnp.int16),
         jnp.int32(0),
         seqv0,
         jnp.arange(K) != 0,  # node 0's original entry is the in-hand pop
-        jnp.ones((K,), bool),  # alive: cleared by on-device discards
+        jnp.arange(K) < n_live,  # alive: pool/pad nodes join on creation
         jnp.int32(K + 1),
+        n_live.astype(jnp.int32),  # pool_next: next free pool node
+        jnp.int32(0),              # cre_count
+        jnp.zeros((CRE_CAP,), jnp.int32),  # cre_parent
+        jnp.zeros((CRE_CAP,), jnp.int32),  # cre_kind
+        jnp.zeros((CRE_CAP,), jnp.int32),  # cre_sym1
+        jnp.zeros((CRE_CAP,), jnp.int32),  # cre_sym2
+        jnp.zeros((CRE_CAP,), jnp.int32),  # cre_len
+        jnp.int32(0),              # stop_diag
         jnp.int32(0),
         jnp.int32(0),
     )
-    (D, e, rmin, er, act, cons, clen, _lc, _pc, _tr, steps, hist,
-     nsteps, _seqv, _fresh, alive, _ctr, code, stop_node) = lax.while_loop(
-        lambda c: c[17] == 0, body, init
+    (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
+     _lc, _pc, _tr, steps, hist, nsteps, _seqv, _fresh, alive, _ctr,
+     _pool, cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len,
+     stop_diag, code, stop_node) = lax.while_loop(
+        lambda c: c[28] == 0, body, init
     )
 
-    eds, occ, split, reached = stats_all(D, e, rmin, er, act, clen)
+    eds, occ, split, reached = stats_all(
+        D, e, rmin, er, offs, act, clen, off0s
+    )
 
     out = dict(state)
     out["D"] = state["D"].at[slots].set(D)
@@ -1660,10 +2132,68 @@ def _j_arena(
     out["act"] = state["act"].at[slots].set(act)
     out["cons"] = state["cons"].at[slots].set(cons)
     out["clen"] = state["clen"].at[slots].set(clen)
+    # off rows are carried (children inherit their parent's) and MUST be
+    # scattered back: a created child's global off row is otherwise the
+    # pool slot's stale garbage, corrupting its first post-arena push on
+    # any offset workload (existing rows are rewritten unchanged)
+    out["off"] = state["off"].at[slots].set(offs)
     return (
         out, hist, nsteps, code, stop_node, steps,
         (eds, occ, split, reached), act, cons, clen, alive,
+        cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len,
+        stop_diag,
     )
+
+
+@partial(jax.jit, static_argnames=("P", "M"))
+def _j_offset_scan(cons_win, heads, m, wc, P: int, M: int):
+    """Batched activation-offset scoring (the second batchable kernel,
+    SURVEY §3.5; reference loop ``/root/reference/src/consensus.rs:413-448``):
+    for every window position ``p < P`` and head lane ``b``,
+    ``ed[b, p] = min_j Lev(head[b][:m], cons_win[p : p + j])`` — the
+    prefix-mode semantics of ``wfa_ed_config(require_both_end=False)``
+    as one dense DP instead of ``offset_window`` serial host WFAs.
+
+    ``cons_win`` is ``[P + 2M] int32`` dense symbol ids padded with a
+    never-matching sentinel (alignments into padding can never beat the
+    unpadded optimum: every pad char adds >= 1 cost).  ``heads`` is
+    ``[B, M] int32`` (its own sentinel).  ``j`` ranges to ``2M``: any
+    longer consensus prefix costs ``j - m > m >= Lev(head, empty)``.
+    The wildcard matches on either side, as in ``wfa_ed_config``.
+    """
+    B = heads.shape[0]
+    Jmax = 2 * M
+    iidx = jnp.arange(M + 1, dtype=jnp.int32)
+    pidx = jnp.arange(P, dtype=jnp.int32)
+    Wn = cons_win.shape[0]
+    col0 = jnp.broadcast_to(iidx[None, None, :], (B, P, M + 1)).astype(
+        jnp.int32
+    )
+    best0 = jnp.minimum(jnp.full((B, P), Jmax + M + 5, jnp.int32), m)
+
+    def body(j, carry):
+        col, best = carry
+        cj = cons_win[jnp.clip(pidx + j - 1, 0, Wn - 1)]  # [P]
+        match = (
+            (heads[:, None, :] == cj[None, :, None])
+            | ((wc >= 0) & (heads[:, None, :] == wc))
+            | ((wc >= 0) & (cj[None, :, None] == wc))
+        )
+        sub = col[:, :, :-1] + jnp.where(match, 0, 1)
+        dele = col[:, :, 1:] + 1
+        tmp = jnp.minimum(sub, dele)
+        new0 = jnp.full((B, P, 1), j, jnp.int32)
+        tmp_full = jnp.concatenate([new0, tmp], axis=2)
+        # insertion chain new[i] = min_{k<=i} tmp_full[k] + (i - k)
+        adj = tmp_full - iidx[None, None, :]
+        new = lax.cummin(adj, axis=2) + iidx[None, None, :]
+        ed_m = jnp.take_along_axis(
+            new, jnp.full((B, P, 1), m, jnp.int32), axis=2
+        )[..., 0]
+        return new, jnp.minimum(best, ed_m)
+
+    _col, best = lax.fori_loop(1, Jmax + 1, body, (col0, best0))
+    return best
 
 
 @partial(jax.jit, static_argnames=("W",))
@@ -2203,6 +2733,9 @@ class JaxScorer(WavefrontScorer):
         lock2: bool = False,
         allow_records: bool = True,
         rec_min: int | None = None,
+        mc_tab: np.ndarray | None = None,
+        imb_tab: np.ndarray | None = None,
+        mc_dyn: bool = False,
     ):
         """Device-side dual-node extension (both branches step together,
         with on-device divergence pruning); returns ``(steps, stop_code,
@@ -2212,7 +2745,10 @@ class JaxScorer(WavefrontScorer):
         the engine to replay (cf. ``_j_run``'s record absorption).  See
         ``_j_run_dual`` for the stop-code contract (including the
         one-side-locked mode).  Caller preconditions: at most one side
-        locked, ``min_af == 0``."""
+        locked; with ``min_af != 0`` the caller must supply ``mc_tab`` /
+        ``imb_tab`` (see ``_j_run_dual``) — when omitted both default to
+        constant ``min_count`` / ``imb_min`` tables (the ``min_af == 0``
+        semantics)."""
         self._invalidate_root_stats()
         s1 = self._slot_of[h1]
         s2 = self._slot_of[h2]
@@ -2221,6 +2757,17 @@ class JaxScorer(WavefrontScorer):
             self._grow_cons()
         uni1, off0a = self._uniform_off(s1)
         uni2, off0b = self._uniform_off(s2)
+        if mc_tab is None:
+            mc_tab = np.full(self._R + 1, min_count, dtype=np.int32)
+        # pad to the scorer's read capacity: every distinct engine/group
+        # size would otherwise retrace the kernel (the index is clipped
+        # and a vote total never exceeds the group's read count)
+        mc_tab = self._pad_len_table(mc_tab, self._R + 1)
+        if imb_tab is None:
+            imb_tab = np.full(8, imb_min, dtype=np.int32)
+        imb_tab = self._pad_len_table(
+            imb_tab, max(len(consensus1), len(consensus2)) + max_steps + 2
+        )
         params = np.asarray(
             [
                 s1,
@@ -2240,6 +2787,7 @@ class JaxScorer(WavefrontScorer):
                 int(lock2),
                 int(allow_records),
                 min_count if rec_min is None else rec_min,
+                int(mc_dyn),
             ],
             dtype=np.int32,
         )
@@ -2247,7 +2795,8 @@ class JaxScorer(WavefrontScorer):
          rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2) = (
             _j_run_dual(
                 self._state, self._reads, self._reads_pad, self._rlen,
-                params, self._wc, self._et, self._A, uni1 and uni2,
+                params, np.ascontiguousarray(mc_tab, dtype=np.int32),
+                imb_tab, self._wc, self._et, self._A, uni1 and uni2,
             )
         )
         self._state = state
@@ -2321,6 +2870,14 @@ class JaxScorer(WavefrontScorer):
     #: Sized for the live-chain count of tie-heavy dual searches; per-
     #: iteration compute scales with K but stays tiny for a TPU VPU
     ARENA_K = 48
+    #: engines consult this to decide whether a split-shaped expansion
+    #: can engage the arena (0 would mean no on-device child creation)
+    ARENA_CRE_PER_EVENT = CRE_PER_EVENT
+
+    #: creation pool nodes offered per arena call (each owns two real
+    #: state slots for the duration of the call; unconsumed pairs are
+    #: returned to the free list afterwards)
+    ARENA_POOL = 24
 
     def run_arena(
         self,
@@ -2340,17 +2897,33 @@ class JaxScorer(WavefrontScorer):
         lc: np.ndarray,    # [2, Lw] per-kind queue length counts
         pc: np.ndarray,    # [2, Lw] per-kind processed counts
         tr_scalars: np.ndarray,  # [2, 4] (thr, total, farthest, last_constr)
+        create_mode: int = 0,
+        mc_tab: np.ndarray | None = None,
+        imb_tab: np.ndarray | None = None,
+        split_relax: bool = True,
+        mc_dyn: bool = False,
     ):
         """K-node pop arena (see ``_j_arena``); node 0 must be the
         engine's in-hand pop, later nodes in their queue pop order.
-        Returns ``(hist, nsteps, code, stop_node, per_node_steps,
-        per_side_appended, per_side_stats, per_side_act, alive)`` with
-        sides flattened as ``[n0s1, n0s2, n1s1, ...]`` (side-2 entries
-        of single nodes and all entries of padding nodes are None).
-        ``hist`` entries are node indices for committed pops and
-        ``-(node + 1)`` for on-device discarded pops; ``alive[node]`` is
-        False when the node died mid-arena (caller frees it and must
-        not re-queue it)."""
+        Returns ``(events, nsteps, code, stop_node, per_node_steps,
+        per_side_appended, per_side_stats, per_side_act, alive,
+        creations)`` with sides flattened as ``[n0s1, n0s2, n1s1, ...]``
+        (side-2 entries of single nodes and all entries of unused
+        padding nodes are None).  ``events`` is the committed history as
+        ``("commit", node)`` / ``("discard", node)`` / ``("split",
+        node)`` / ``("create", rec)`` tuples in pop order;
+        ``alive[node]`` is False when the node died mid-arena (caller
+        frees it and must not re-queue it).
+
+        ``create_mode`` (see ``_j_arena``) enables on-device child
+        creation: 1 = singles only, 2 = singles + split pairs + dual
+        cross products.  ``creations[j]`` describes child node
+        ``len(node_specs) + j`` as a dict with ``parent`` (node index —
+        possibly itself a child), ``kind`` (0 single / 1 dual), ``sym1``
+        / ``sym2`` (byte symbols; ``sym2`` None for singles),
+        ``created_len`` (the child's length at creation, i.e. parent
+        length at the split + 1), and fresh registered handles ``h1`` /
+        ``h2`` (``h2`` None for singles)."""
         self._invalidate_root_stats()
         K = self.ARENA_K
         n_live = len(node_specs)
@@ -2369,14 +2942,25 @@ class JaxScorer(WavefrontScorer):
                 slots.append(self._slot_of[h2])
             else:
                 slots.append(self._scratch_slot())
-        for _ in range(K - n_live):
+        # creation pool: real allocated slot pairs the kernel may turn
+        # into child nodes; the remainder of the node table is scratch
+        n_pool = min(self.ARENA_POOL, K - n_live) if create_mode else 0
+        pool_pairs = [
+            (self._alloc(), self._alloc()) for _ in range(n_pool)
+        ]
+        for (h1p, s1p), (h2p, s2p) in pool_pairs:
+            kinds.append(-1)
+            slots.append(s1p)
+            slots.append(s2p)
+        for _ in range(K - n_live - n_pool):
             kinds.append(-1)
             slots.append(self._scratch_slot())
             slots.append(self._scratch_slot())
         if len(set(slots)) != 2 * K:
             raise ValueError("arena requires distinct state slots")
         # dynamic-slice window path: every LIVE side's active reads must
-        # share one offset (scratch sides are garbage either way)
+        # share one offset (scratch sides are garbage either way;
+        # children inherit their parent's offset row on device)
         off0s = np.zeros(2 * K, dtype=np.int32)
         uniform = True
         for side in live_sides:
@@ -2387,6 +2971,14 @@ class JaxScorer(WavefrontScorer):
         max_len = max(max(s[2], s[3]) for s in node_specs)
         while max_len + step_limit + 2 >= self._C:
             self._grow_cons()
+        if mc_tab is None:
+            mc_tab = np.full(self._R + 1, min_count, dtype=np.int32)
+        mc_tab = self._pad_len_table(mc_tab, self._R + 1)
+        if imb_tab is None:
+            imb_tab = np.full(8, imb_min, dtype=np.int32)
+        imb_tab = self._pad_len_table(
+            imb_tab, max_len + step_limit + 2
+        )
         params = np.asarray(
             [
                 min(me_budget, 2**31 - 1),
@@ -2402,12 +2994,17 @@ class JaxScorer(WavefrontScorer):
                 capacity_per_size,
                 step_limit,
                 max_nodes_wo_constraint,
+                int(create_mode),
+                n_pool,
+                int(split_relax),
+                int(mc_dyn),
             ],
             dtype=np.int32,
         )
         seqv0 = np.arange(K, dtype=np.int32)
         (state, hist, nsteps, code, stop_node, steps, stats, act, cons,
-         clen, alive) = (
+         clen, alive, cre_count, cre_parent, cre_kind, cre_sym1,
+         cre_sym2, cre_len, stop_diag) = (
             _j_arena(
                 self._state,
                 self._reads,
@@ -2421,6 +3018,8 @@ class JaxScorer(WavefrontScorer):
                 np.asarray(tr_scalars, dtype=np.int32),
                 np.ascontiguousarray(lc, dtype=np.int32),
                 np.ascontiguousarray(pc, dtype=np.int32),
+                np.ascontiguousarray(mc_tab, dtype=np.int32),
+                imb_tab,
                 self._wc,
                 self._et,
                 self._A,
@@ -2431,43 +3030,124 @@ class JaxScorer(WavefrontScorer):
         )
         self._state = state
         (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
-         cons_np, alive_np) = jax.device_get(
-            (hist, nsteps, code, stop_node, steps, stats, act, cons, alive)
+         cons_np, alive_np, cre_count, stop_diag) = jax.device_get(
+            (hist, nsteps, code, stop_node, steps, stats, act, cons,
+             alive, cre_count, stop_diag)
         )
         nsteps = int(nsteps)
         code = int(code)
         stop_node = int(stop_node)
-        # committed pops keep their node index; discards become -(n+1)
-        hist_np = hist_np.astype(np.int32)
-        hist_np = np.where(hist_np >= K, -(hist_np - K) - 1, hist_np)
+        cre_count = int(cre_count)
+        if code == 1:
+            # why the stopping winner's split wasn't absorbed: child
+            # count + gate flags (see stop_diag in _j_arena)
+            diag = int(stop_diag)
+            key1 = f"arena_s1_nc{diag // 64}_f{diag % 64:02d}"
+            self.counters[key1] = self.counters.get(key1, 0) + 1
+        if cre_count:
+            (cre_parent_np, cre_kind_np, cre_sym1_np, cre_sym2_np,
+             cre_len_np) = jax.device_get(
+                (cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len)
+            )
+
+        # decode the typed event stream
+        events = []
+        for v in hist_np[:nsteps]:
+            v = int(v)
+            if v < K:
+                events.append(("commit", v))
+            elif v < 2 * K:
+                events.append(("discard", v - K))
+            elif v < 3 * K:
+                events.append(("split", v - 2 * K))
+            else:
+                events.append(("create", v - 3 * K))
+
+        # creation records -> child descriptors with registered handles;
+        # unconsumed pool pairs (and the unused side-2 slot of single
+        # children) go straight back to the free list
+        creations = []
+        for j in range(cre_count):
+            (h1p, _s1p), (h2p, _s2p) = pool_pairs[j]
+            kind_j = int(cre_kind_np[j])
+            creations.append(
+                {
+                    "parent": int(cre_parent_np[j]),
+                    "kind": kind_j,
+                    "sym1": int(self.symtab[int(cre_sym1_np[j])]),
+                    "sym2": (
+                        int(self.symtab[int(cre_sym2_np[j])])
+                        if kind_j == 1
+                        else None
+                    ),
+                    "created_len": int(cre_len_np[j]),
+                    "h1": h1p,
+                    "h2": h2p if kind_j == 1 else None,
+                }
+            )
+            if kind_j == 0:
+                self.free(h2p)
+        for j in range(cre_count, n_pool):
+            (h1p, _), (h2p, _) = pool_pairs[j]
+            self.free(h1p)
+            self.free(h2p)
+
         self.counters["arena_calls"] = self.counters.get("arena_calls", 0) + 1
         self.counters["arena_steps"] = (
             self.counters.get("arena_steps", 0) + nsteps
         )
         key = f"arena_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
-        n_disc = int(np.count_nonzero(~alive_np[:n_live]))
+        n_disc = int(np.count_nonzero(~alive_np[: n_live + cre_count]))
         if n_disc:
             self.counters["arena_discards"] = (
                 self.counters.get("arena_discards", 0) + n_disc
+            )
+        if cre_count:
+            self.counters["arena_creations"] = (
+                self.counters.get("arena_creations", 0) + cre_count
+            )
+            self.counters["arena_split_events"] = (
+                self.counters.get("arena_split_events", 0)
+                + sum(1 for kind, _ in events if kind == "split")
             )
         # arena divergence pruning deactivates lanes on device; mirror it
         for side in live_sides:
             self._act_host[slots[side]] = act_np[side]
 
+        # per-node effective (kind, l0_side1, l0_side2) covering children
+        eff = []
+        for i in range(n_live):
+            eff.append((kinds[i], node_specs[i][2], node_specs[i][3]))
+        for j, cre in enumerate(creations):
+            eff.append((cre["kind"], cre["created_len"], cre["created_len"]))
+            # host offset mirrors for the consumed pool slots (the act
+            # mirror comes from the device act rows below)
+            pk = eff[cre["parent"]][0]
+            p1s = slots[2 * cre["parent"]]
+            src2 = slots[2 * cre["parent"] + (1 if pk == 1 else 0)]
+            c1s = slots[2 * (n_live + j)]
+            self._off_host[c1s] = self._off_host[p1s]
+            self._act_host[c1s] = act_np[2 * (n_live + j)]
+            if cre["kind"] == 1:
+                c2s = slots[2 * (n_live + j) + 1]
+                self._off_host[c2s] = self._off_host[src2]
+                self._act_host[c2s] = act_np[2 * (n_live + j) + 1]
+
         appended = []
         sides_stats = []
         sides_act = []
         n = self.num_reads
+        n_nodes = n_live + cre_count
         for f in range(2 * K):
             node = f // 2
-            if node >= n_live or (f % 2 == 1 and kinds[node] == 0):
+            if node >= n_nodes or (f % 2 == 1 and eff[node][0] == 0):
                 appended.append(None)
                 sides_stats.append(None)
                 sides_act.append(None)
                 continue
             k_steps = int(steps_np[node])
-            l0 = node_specs[node][2 + (f % 2)]
+            l0 = eff[node][1 + (f % 2)]
             ids = cons_np[f, l0 : l0 + k_steps]
             appended.append(self.symtab[ids].astype(np.uint8).tobytes())
             sides_stats.append(
@@ -2484,7 +3164,7 @@ class JaxScorer(WavefrontScorer):
         if code == 5:
             self._grow_e()
         return (
-            hist_np[:nsteps],
+            events,
             nsteps,
             code,
             stop_node,
@@ -2493,7 +3173,64 @@ class JaxScorer(WavefrontScorer):
             sides_stats,
             sides_act,
             [bool(a) for a in alive_np],
+            creations,
         )
+
+    def best_activation_offset(
+        self,
+        consensus: bytes,
+        seq_index: int,
+        offset_window: int,
+        offset_compare_length: int,
+        wildcard,
+    ) -> int:
+        """Device-batched activation-offset search (one ``_j_offset_scan``
+        dispatch scoring the whole window) with the host loop's exact
+        first-best/midpoint-incumbent tie semantics; tiny problems fall
+        back to the host WFA loop."""
+        seq = self.reads[seq_index]
+        cmp_len = min(offset_compare_length, len(seq))
+        con_len = len(consensus)
+        start = max(0, con_len - (offset_window + cmp_len))
+        end = max(0, con_len - cmp_len)
+        n_pos = end - start
+        if n_pos <= 1 or cmp_len * n_pos < 512:
+            from waffle_con_tpu.ops.scorer import find_activation_offset
+
+            return find_activation_offset(
+                consensus, seq, offset_window, offset_compare_length,
+                wildcard,
+            )
+        M = _next_pow2(cmp_len)
+        P = _next_pow2(n_pos)
+        win = np.full(P + 2 * M, -2, dtype=np.int32)
+        tail = consensus[start : min(con_len, start + P + 2 * M)]
+        win[: len(tail)] = [self.sym_id[b] for b in tail]
+        head = np.full((1, M), -3, dtype=np.int32)
+        head[0, :cmp_len] = [self.sym_id[b] for b in seq[:cmp_len]]
+        self.counters["offset_scan_calls"] = (
+            self.counters.get("offset_scan_calls", 0) + 1
+        )
+        eds = np.asarray(
+            _j_offset_scan(win, head, np.int32(cmp_len), self._wc, P, M)
+        )[0]
+        best_offset = max(0, con_len - (cmp_len + offset_window // 2))
+        min_ed = int(eds[best_offset - start])
+        for p in range(n_pos):
+            if int(eds[p]) < min_ed:
+                min_ed = int(eds[p])
+                best_offset = start + p
+        return best_offset
+
+    @staticmethod
+    def _pad_len_table(tab: np.ndarray, need: int) -> np.ndarray:
+        """Pad a per-length int table to a power-of-two length >= need
+        with its final value (tables are constant past the last
+        activation point), bounding the number of compiled geometries."""
+        n = _next_pow2(max(int(need), len(tab), 8))
+        out = np.full(n, tab[-1], dtype=np.int32)
+        out[: len(tab)] = tab
+        return out
 
     def _scratch_reset(self) -> None:
         self._scratch_next = 0

@@ -120,6 +120,22 @@ class WavefrontScorer:
     def num_symbols(self) -> int:
         return len(self.symtab)
 
+    def best_activation_offset(
+        self,
+        consensus: bytes,
+        seq_index: int,
+        offset_window: int,
+        offset_compare_length: int,
+        wildcard: Optional[int],
+    ) -> int:
+        """Best starting offset for a late-activating read (see
+        :func:`find_activation_offset`); device backends batch the whole
+        window into one kernel call."""
+        return find_activation_offset(
+            consensus, self.reads[seq_index], offset_window,
+            offset_compare_length, wildcard,
+        )
+
     # -- branch lifecycle ------------------------------------------------
     def root(self, active: np.ndarray) -> int:
         raise NotImplementedError
@@ -304,6 +320,10 @@ class SubsetScorer(WavefrontScorer):
         return self.base.ARENA_K
 
     @property
+    def ARENA_CRE_PER_EVENT(self):
+        return getattr(self.base, "ARENA_CRE_PER_EVENT", 0)
+
+    @property
     def counters(self):
         return getattr(self.base, "counters", {})
 
@@ -368,6 +388,15 @@ class SubsetScorer(WavefrontScorer):
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
         return self.base.finalized_eds(h, consensus)[self.indices]
 
+    def best_activation_offset(
+        self, consensus, seq_index, offset_window, offset_compare_length,
+        wildcard,
+    ) -> int:
+        return self.base.best_activation_offset(
+            consensus, int(self.indices[seq_index]), offset_window,
+            offset_compare_length, wildcard,
+        )
+
     # -- device fast paths (shadowed with None when the base lacks them)
     def run_extend(self, h, consensus, *args, **kwargs):
         steps, code, appended, stats, records = self.base.run_extend(
@@ -403,8 +432,8 @@ class SubsetScorer(WavefrontScorer):
         )
 
     def run_arena(self, *args, **kwargs):
-        (hist, nsteps, code, stop_node, node_steps, appended,
-         sides_stats, sides_act, alive) = self.base.run_arena(
+        (events, nsteps, code, stop_node, node_steps, appended,
+         sides_stats, sides_act, alive, creations) = self.base.run_arena(
             *args, **kwargs
         )
         idx = self.indices
@@ -413,8 +442,8 @@ class SubsetScorer(WavefrontScorer):
         ]
         sides_act = [a[idx] if a is not None else None for a in sides_act]
         return (
-            hist, nsteps, code, stop_node, node_steps, appended,
-            sides_stats, sides_act, alive,
+            events, nsteps, code, stop_node, node_steps, appended,
+            sides_stats, sides_act, alive, creations,
         )
 
 

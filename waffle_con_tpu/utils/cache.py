@@ -7,20 +7,44 @@ seconds."""
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def _host_fingerprint() -> str:
+    """A digest of the host CPU's feature set.  XLA:CPU caches AOT
+    machine code for the COMPILING host; loading it on a host missing
+    any of those features can SIGILL (observed live: a cache populated
+    on an AVX512-full machine crashed the test suite on a smaller one).
+    Scoping the cache directory by this fingerprint makes cross-host
+    pollution structurally impossible."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+            else:
+                feats = platform.processor()
+    except OSError:  # pragma: no cover - non-Linux fallback
+        feats = platform.processor()
+    return hashlib.sha256(feats.encode()).hexdigest()[:12]
 
 
 def enable_compilation_cache(path: str | None = None) -> None:
     """Point JAX's persistent compilation cache at ``path`` (default
-    ``$JAX_CACHE_DIR`` or ``~/.cache/waffle_con_tpu_jax``).  Safe to call
-    multiple times."""
+    ``$JAX_CACHE_DIR`` or ``~/.cache/waffle_con_tpu_jax-<cpu-digest>``).
+    Safe to call multiple times."""
     import jax
 
     if path is None:
         path = os.environ.get(
             "JAX_CACHE_DIR",
             os.path.join(
-                os.path.expanduser("~"), ".cache", "waffle_con_tpu_jax"
+                os.path.expanduser("~"),
+                ".cache",
+                f"waffle_con_tpu_jax-{_host_fingerprint()}",
             ),
         )
     os.makedirs(path, exist_ok=True)
