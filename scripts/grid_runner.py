@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Run the reference criterion grid one config per subprocess, appending
+JSONL lines as they complete (CPU-pinned; survives individual config
+timeouts).  Usage: python scripts/grid_runner.py OUT.jsonl [timeout_s]"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {root!r})
+from waffle_con_tpu.utils.cache import enable_compilation_cache
+enable_compilation_cache()
+import bench
+out = bench.bench_single({ns}, {sl}, {er})
+out["metric"] = "consensus_4x{sl}x{ns}_{er}"
+print("GRIDLINE " + json.dumps(out))
+"""
+
+
+def main():
+    out_path = sys.argv[1]
+    timeout_s = int(sys.argv[2]) if len(sys.argv) > 2 else 1800
+    for sl in (1000, 10_000):
+        for ns in (8, 30):
+            for er in (0.0, 0.01, 0.02):
+                code = CHILD.format(root=ROOT, ns=ns, sl=sl, er=er)
+                t0 = time.time()
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, "-c", code],
+                        capture_output=True,
+                        text=True,
+                        timeout=timeout_s,
+                    )
+                    line = None
+                    for ln in (proc.stdout or "").splitlines():
+                        if ln.startswith("GRIDLINE "):
+                            line = json.loads(ln[len("GRIDLINE "):])
+                    if line is None:
+                        line = {
+                            "metric": f"consensus_4x{sl}x{ns}_{er}",
+                            "error": f"rc={proc.returncode}: "
+                            + (proc.stderr or "")[-300:],
+                        }
+                except subprocess.TimeoutExpired:
+                    line = {
+                        "metric": f"consensus_4x{sl}x{ns}_{er}",
+                        "error": f"timeout after {timeout_s}s",
+                    }
+                line["runner_wall_s"] = round(time.time() - t0, 1)
+                with open(out_path, "a") as f:
+                    f.write(json.dumps(line) + "\n")
+                print(line.get("metric"), line.get("value", line.get("error")),
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

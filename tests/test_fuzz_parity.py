@@ -89,6 +89,43 @@ def test_dual_engine_fuzz(seed):
 
 
 @pytest.mark.parametrize("seed", range(4))
+def test_dual_engine_offset_fuzz(seed):
+    """Dual splits WITH late-activating reads: the regression class of
+    the arena child-creation `off`-row scatter bug (children created on
+    device inherited a stale offset row, visible only one push after
+    the arena and only on offset workloads)."""
+    rng = np.random.default_rng(8600 + seed)
+    seq_len = int(rng.integers(180, 320))
+    half = int(rng.integers(4, 6))
+    truth, reads1 = generate_test(4, seq_len, half, 0.01, seed=8700 + seed)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    reads = list(reads1) + [
+        corrupt(bytes(h2), 0.01, np.random.default_rng(8800 + seed * 8 + i))
+        for i in range(half)
+    ]
+    offsets = [None] * len(reads)
+    for j in range(2):
+        off = int(rng.integers(60, seq_len // 2))
+        reads.append(
+            corrupt(
+                reads[j][off:], 0.01, np.random.default_rng(8900 + seed * 8 + j)
+            )
+        )
+        offsets.append(off)
+    engines = []
+    for backend in ("python", "jax"):
+        e = DualConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=2)
+        )
+        for r, off in zip(reads, offsets):
+            e.add_sequence_offset(r, off)
+        engines.append(e)
+    assert engines[0].consensus() == engines[1].consensus()
+
+
+@pytest.mark.parametrize("seed", range(4))
 def test_single_engine_offset_fuzz(seed):
     """Late-starting reads: the windowed activation path plus the
     gather-variant (non-uniform-offset) device kernels."""

@@ -750,7 +750,8 @@ class ConsensusDWFA:
         if pqueue.is_empty():
             return None  # no competitor: the plain run path is strictly better
         taken = []
-        while len(taken) < scorer.ARENA_K - 1 and not pqueue.is_empty():
+        take_max = getattr(scorer, "ARENA_TAKE_MAX", scorer.ARENA_K - 1)
+        while len(taken) < take_max and not pqueue.is_empty():
             taken.append(pqueue.pop_with_seq())
         nodes = [node] + [t[0] for t in taken]
 

@@ -552,6 +552,11 @@ class DualConsensusDWFA:
                     return False
                 if cfg.min_af == 0.0:
                     return True
+                wc_id = (
+                    scorer.sym_id.get(cfg.wildcard)
+                    if cfg.wildcard is not None
+                    else None
+                )
                 for active, stats in (
                     (nd.active1, nd.stats1),
                     (nd.active2, nd.stats2) if nd.is_dual else (None, None),
@@ -563,6 +568,17 @@ class DualConsensusDWFA:
                     voting = np.asarray(active, dtype=bool) & (split > 0)
                     if (nondyadic & voting).any():
                         return False
+                    # mixed wildcard/non-wildcard tips leave a fractional
+                    # surviving-vote total after the wc drop — the
+                    # kernel's integer mc-table index then refuses
+                    # (tab_bad), so don't burn the dispatch
+                    if wc_id is not None:
+                        mixed = (
+                            (stats.occ[:, wc_id] > 0)
+                            & (stats.occ.sum(axis=1) > stats.occ[:, wc_id])
+                        )
+                        if (mixed & voting).any():
+                            return False
                 return True
 
             if kernels_ok:
@@ -934,7 +950,8 @@ class DualConsensusDWFA:
         # collect the next-best compatible competitors, in pop order; the
         # first ineligible entry becomes the arena's rest-of-queue bound
         taken = []
-        while len(taken) < scorer.ARENA_K - 1 and not pqueue.is_empty():
+        take_max = getattr(scorer, "ARENA_TAKE_MAX", scorer.ARENA_K - 1)
+        while len(taken) < take_max and not pqueue.is_empty():
             cand, pri, seq = pqueue.pop_with_seq()
             if cand.is_dual and (cand.lock1 or cand.lock2):
                 pqueue.push_restored(cand.key(), cand, pri, seq)

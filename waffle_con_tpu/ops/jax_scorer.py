@@ -1515,22 +1515,18 @@ def _j_arena(
         totals = jnp.where(alive & (kinds >= 0), totals, BIGTOT)
 
         # ---- pop-winner tournament: host priority is (-cost, len) with
-        # FIFO (smaller seq rank) on full ties
-        def better(i, j):
-            wi = (
-                (totals[i] < totals[j])
-                | ((totals[i] == totals[j]) & (lens[i] > lens[j]))
-                | (
-                    (totals[i] == totals[j])
-                    & (lens[i] == lens[j])
-                    & (seqv[i] < seqv[j])
-                )
-            )
-            return jnp.where(wi, i, j)
-
-        win = jnp.int32(0)
-        for j in range(1, K):
-            win = better(win, jnp.int32(j))
+        # FIFO (smaller seq rank) on full ties.  Vectorized reductions:
+        # an unrolled K-deep comparison chain bloated the compiled graph
+        # (XLA:CPU flakily segfaulted compiling the arena at K=48)
+        min_total = totals.min()
+        cand1 = totals == min_total
+        best_len = jnp.where(cand1, lens, -1).max()
+        cand2 = cand1 & (lens == best_len)
+        win = (
+            jnp.where(cand2, seqv, jnp.int32(2**31 - 1))
+            .argmin()
+            .astype(jnp.int32)
+        )
         first = nsteps == 0
         win = jnp.where(first, 0, win)
         wtot = totals[win]
@@ -2865,19 +2861,29 @@ class JaxScorer(WavefrontScorer):
 
     @property
     def ARENA_CAP(self) -> int:
-        return min(self.ARENA_CAP_MAX, max(512, _next_pow2(self._L // 2)))
+        # sized to the read length so one engagement can carry a search
+        # through a full consensus-length stretch of events; history is
+        # int16 so even the 2048 ceiling costs 4 KB (step-limit stops
+        # were the top residual dispatch source at benchmark scale)
+        return min(self.ARENA_CAP_MAX, max(512, _next_pow2(self._L)))
     #: node capacity of the arena kernel (static; dead-node padding).
     #: Sized for the live-chain count of tie-heavy dual searches; per-
     #: iteration compute scales with K but stays tiny for a TPU VPU
-    ARENA_K = 48
+    ARENA_K = 64
+    #: engines cap the competitors they take at this, reserving node
+    #: slots for the creation pool — tie-heavy engagements otherwise
+    #: fill the table (n_live ~ K) and every split stops pool-starved
+    ARENA_TAKE_MAX = ARENA_K - 1 - 16
     #: engines consult this to decide whether a split-shaped expansion
     #: can engage the arena (0 would mean no on-device child creation)
     ARENA_CRE_PER_EVENT = CRE_PER_EVENT
 
     #: creation pool nodes offered per arena call (each owns two real
     #: state slots for the duration of the call; unconsumed pairs are
-    #: returned to the free list afterwards)
-    ARENA_POOL = 24
+    #: returned to the free list afterwards).  Sized close to ARENA_K:
+    #: pool exhaustion was the dominant residual stop once splits were
+    #: absorbed (the n_live cap keeps the sum within the node table)
+    ARENA_POOL = 36
 
     def run_arena(
         self,

@@ -324,6 +324,10 @@ class SubsetScorer(WavefrontScorer):
         return getattr(self.base, "ARENA_CRE_PER_EVENT", 0)
 
     @property
+    def ARENA_TAKE_MAX(self):
+        return getattr(self.base, "ARENA_TAKE_MAX", self.base.ARENA_K - 1)
+
+    @property
     def counters(self):
         return getattr(self.base, "counters", {})
 
