@@ -547,6 +547,43 @@ def _j_finalize(state, h):
     return _finalized(state["e"][h], state["rmin"][h], state["act"][h], E)
 
 
+
+def _nominate_side(occ, split, w, wc, weighted, mc_tab, mc_dyn):
+    """Per-side vote fold + nomination decision — THE shared copy for
+    the dual run loop and the arena (their dirty/stop decisions must
+    stay bit-identical or the two fast paths diverge on the same node).
+
+    The integer mc-table index is only the host's arithmetic when the
+    surviving-vote total IS integer (wildcard-tip drops can leave
+    fractional totals) — with a dynamic table (``mc_dyn``) those
+    decisions bounce to the host.  Returns ``(dirty, sym, counts,
+    has_votes, exactable, mc, near_tie)``."""
+    counts, has_votes, n_cands, exactable = _dual_votes(
+        occ, split, w, wc, weighted
+    )
+    EPS = VOTE_EPS
+    MCN = mc_tab.shape[0]
+    n_vote_f = counts.sum()
+    n_vote = jnp.round(n_vote_f).astype(jnp.int32)
+    int_ok = jnp.abs(n_vote_f - jnp.round(n_vote_f)) < EPS
+    tab_bad = mc_dyn & ~int_ok
+    exactable = exactable & ~tab_bad
+    mc = mc_tab[jnp.clip(n_vote, 0, MCN - 1)]
+    mc_f = mc.astype(jnp.float32)
+    maxc = jnp.where(has_votes, counts, -1.0).max()
+    thr = jnp.minimum(mc_f, maxc)
+    passing = has_votes & (counts >= thr)
+    npass = passing.sum()
+    near_tie = (
+        (jnp.abs(maxc - mc_f) < EPS)
+        | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
+    )
+    ambiguous = ~exactable & near_tie
+    dirty = ambiguous | (npass != 1) | (n_cands == 0) | tab_bad
+    sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(jnp.int32)
+    return dirty, sym, counts, has_votes, exactable, mc, near_tie
+
+
 @partial(
     jax.jit, static_argnames=("num_symbols", "uniform"), donate_argnums=(0,)
 )
@@ -1013,33 +1050,9 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
         wb = jnp.where(weighted, wb_soft, jnp.where(actb, 1.0, 0.0))
 
         def side(occ, split, w):
-            counts, has_votes, n_cands, exactable = _dual_votes(
-                occ, split, w, wc, weighted
-            )
-            # per-side dynamic min count: the host's vote-total form.
-            # The integer table index is only the host's arithmetic when
-            # the surviving-vote total IS integer (wildcard-tip drops
-            # can leave fractional totals) — otherwise, with a dynamic
-            # table, the decision must bounce to the host
-            n_vote_f = counts.sum()
-            n_vote = jnp.round(n_vote_f).astype(jnp.int32)
-            int_ok = jnp.abs(n_vote_f - jnp.round(n_vote_f)) < EPS
-            tab_bad = mc_dyn & ~int_ok
-            exactable = exactable & ~tab_bad
-            mc_f = mc_tab[jnp.clip(n_vote, 0, MCN - 1)].astype(jnp.float32)
-            maxc = jnp.where(has_votes, counts, -1.0).max()
-            thr = jnp.minimum(mc_f, maxc)
-            passing = has_votes & (counts >= thr)
-            npass = passing.sum()
-            near_tie = (
-                (jnp.abs(maxc - mc_f) < EPS)
-                | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
-            )
-            ambiguous = ~exactable & near_tie
-            dirty = ambiguous | (npass != 1) | (n_cands == 0) | tab_bad
-            sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(
-                jnp.int32
-            )
+            dirty, sym = _nominate_side(
+                occ, split, w, wc, weighted, mc_tab, mc_dyn
+            )[:2]
             return dirty, sym
 
         dirty_a, sym_a = side(occa, splita, wa)
@@ -1391,34 +1404,7 @@ def _j_arena(
     IMBN = imb_tab.shape[0]
 
     def nominate(occ, split, w):
-        """Vote fold + decision for one side; returns
-        (dirty, sym, counts, has_votes, exactable, mc)."""
-        counts, has_votes, n_cands, exactable = _dual_votes(
-            occ, split, w, wc, weighted
-        )
-        # per-side dynamic min count (host vote-total form; constant
-        # min_count when min_af == 0).  A fractional surviving-vote
-        # total (wildcard-tip drops) cannot index the integer table, so
-        # with a dynamic table those decisions bounce to the host
-        n_vote_f = counts.sum()
-        n_vote = jnp.round(n_vote_f).astype(jnp.int32)
-        int_ok = jnp.abs(n_vote_f - jnp.round(n_vote_f)) < EPS
-        tab_bad = mc_dyn & ~int_ok
-        exactable = exactable & ~tab_bad
-        mc = mc_tab[jnp.clip(n_vote, 0, MCN - 1)]
-        mc_f = mc.astype(jnp.float32)
-        maxc = jnp.where(has_votes, counts, -1.0).max()
-        thr = jnp.minimum(mc_f, maxc)
-        passing = has_votes & (counts >= thr)
-        npass = passing.sum()
-        near_tie = (
-            (jnp.abs(maxc - mc_f) < EPS)
-            | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
-        )
-        ambiguous = ~exactable & near_tie
-        dirty = ambiguous | (npass != 1) | (n_cands == 0) | tab_bad
-        sym = jnp.argmax(jnp.where(passing, counts, -1.0)).astype(jnp.int32)
-        return dirty, sym, counts, has_votes, exactable, mc, near_tie
+        return _nominate_side(occ, split, w, wc, weighted, mc_tab, mc_dyn)
 
     def node_eval(dual, off2, act2, eds2, occ2, split2, reached2, clen2):
         """Per-node decision inputs; side axes are [2, ...]."""
