@@ -22,11 +22,7 @@ jax.config.update("jax_platforms", "cpu")  # reliable CPU pin (see bench.py)
 
 import numpy as np
 
-DISPATCH_KEYS = (
-    "push_calls", "run_calls", "stats_calls", "clone_calls",
-    "clone_push_calls", "activate_calls", "finalize_calls",
-    "arena_calls", "run_dual_calls", "deactivate_calls",
-)
+from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS as DISPATCH_KEYS
 
 
 def _cfg(backend, min_count, band):

@@ -983,8 +983,6 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
     C = state["cons"].shape[1]
     offa = state["off"][ha]
     offb = state["off"][hb]
-    EPS = VOTE_EPS
-    MCN = mc_tab.shape[0]
     IMBN = imb_tab.shape[0]
 
     def stats_at(D, e, rmin, er, off, act, clen, off0):

@@ -62,6 +62,16 @@ class BranchStats:
         self.fin = fin
 
 
+#: counter names that each correspond to one blocking device dispatch;
+#: the dispatch-evidence script and the regression tests sum these so
+#: the budget they enforce is the same quantity the evidence records
+DISPATCH_COUNTER_KEYS = (
+    "push_calls", "run_calls", "stats_calls", "clone_calls",
+    "clone_push_calls", "activate_calls", "finalize_calls",
+    "arena_calls", "run_dual_calls",
+)
+
+
 def build_symbol_table(reads: Sequence[bytes], wildcard: Optional[int]) -> np.ndarray:
     """Dense symbol table: sorted distinct bytes over all reads (plus the
     wildcard if configured).  Index in this array == dense id."""
