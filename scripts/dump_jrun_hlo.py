@@ -1,0 +1,62 @@
+"""Dump optimized TPU HLO for ``_j_run`` at north-star shapes and print
+an opcode histogram of the while-loop body (launch count ~= per-step
+kernel count, the latency driver)."""
+import re
+import sys
+from collections import Counter
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from waffle_con_tpu.config import CdwfaConfigBuilder
+from waffle_con_tpu.ops.jax_scorer import JaxScorer, _j_run
+from waffle_con_tpu.utils.example_gen import generate_test
+
+truth, reads = generate_test(4, 2_000, 256, 0.01, seed=0)
+cfg = (
+    CdwfaConfigBuilder().min_count(64).backend("jax").initial_band(216)
+    .build()
+)
+sc = JaxScorer(reads, cfg)
+h = sc.root(np.ones(len(reads), dtype=bool))
+slot = sc._slot_of[h]
+params = np.asarray(
+    [slot, 2**31 - 1, 2**31 - 1, 0, 64, 0, 1000, 0, -1, 1], dtype=np.int32
+)
+lowered = _j_run.lower(
+    sc._state, sc._reads, sc._reads_pad, sc._rlen, params, sc._wc, sc._et,
+    sc._A, True,
+)
+txt = lowered.compile().as_text()
+out = "/tmp/jrun_hlo.txt"
+with open(out, "w") as f:
+    f.write(txt)
+print(f"wrote {len(txt)} bytes to {out}")
+
+# find the while body computation: the largest computation mentioning
+# "body" in its name
+bodies = {}
+cur = None
+for line in txt.splitlines():
+    m = re.match(r"%?([\w.\-]*body[\w.\-]*) (?:\([^)]*\) -> .*{)", line)
+    if line.startswith("}"):  # computation end
+        cur = None
+    if m:
+        cur = m.group(1)
+        bodies[cur] = []
+    elif cur is not None:
+        bodies[cur].append(line)
+
+for name, lines in bodies.items():
+    ops = Counter()
+    for ln in lines:
+        m = re.match(r"\s*(?:ROOT )?%?[\w.\-]+ = \S+ ([\w\-]+)\(", ln)
+        if m:
+            ops[m.group(1)] += 1
+    total = sum(ops.values())
+    if total < 10:
+        continue
+    print(f"\n== {name}: {total} HLO ops")
+    for op, n in ops.most_common(20):
+        print(f"  {op:30s} {n}")

@@ -1,0 +1,48 @@
+"""Time the REAL ``_j_run`` kernel through the scorer at north-star
+shapes, isolating device per-step cost from engine/host overhead."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from waffle_con_tpu.config import CdwfaConfigBuilder
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.utils.example_gen import generate_test
+
+STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+BAND = int(sys.argv[2]) if len(sys.argv) > 2 else 216
+
+truth, reads = generate_test(4, 10_000, 256, 0.01, seed=0)
+cfg = (
+    CdwfaConfigBuilder().min_count(64).backend("jax").initial_band(BAND)
+    .build()
+)
+sc = JaxScorer(reads, cfg)
+h = sc.root(np.ones(len(reads), dtype=bool))
+print(f"band E={sc.bucket_e} W={sc._W} R={len(reads)}")
+
+
+def one():
+    t = time.perf_counter()
+    steps, code, appended, stats, records = sc.run_extend(
+        h, b"", me_budget=2**31 - 1, other_cost=2**31 - 1, other_len=0,
+        min_count=64, l2=False, max_steps=STEPS,
+    )
+    dt = time.perf_counter() - t
+    return dt, steps, code
+
+
+dt, steps, code = one()  # compile + run
+print(f"warm-up: {dt:.2f}s steps={steps} code={code}")
+# fresh branch each time (run mutates the branch)
+for i in range(3):
+    sc.free(h)
+    h = sc.root(np.ones(len(reads), dtype=bool))
+    dt, steps, code = one()
+    print(
+        f"run {i}: {dt*1e3:8.1f} ms  steps={steps} code={code} "
+        f"{dt/max(steps,1)*1e6:7.2f} us/step"
+    )

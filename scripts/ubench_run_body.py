@@ -1,0 +1,137 @@
+"""Microbenchmark the run-kernel while-loop on the live device.
+
+Measures, at north-star shapes (R=256, band E=216 -> W=434, A=5):
+  1. an EMPTY while loop (pure loop-control floor),
+  2. col-step only,
+  3. col-step + stats/vote fold (the real body shape),
+  4. the same with a K-chunked body (K col+stats per iteration)
+to locate the per-iteration overhead and the win from chunking.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from waffle_con_tpu.ops.jax_scorer import (
+    _col_step_u, _stats_core_u, _init_col, INF,
+)
+
+R, E, A = 256, 216, 5
+W = 2 * E + 2
+L = 10_000
+STEPS = 2_000
+
+rng = np.random.default_rng(0)
+reads = rng.integers(0, 4, size=(R, L)).astype(np.int32)
+reads_pad = jnp.asarray(
+    np.concatenate([np.zeros((R, W), np.int32), reads], axis=1)
+)
+rlen = jnp.full((R,), L, jnp.int32)
+off = jnp.zeros((R,), jnp.int32)
+act = jnp.ones((R,), bool)
+wc = jnp.int32(-2)
+et = jnp.asarray(False)
+off0 = jnp.int32(0)
+
+D0, e0, rmin0, er0 = _init_col(off, act, rlen, jnp.int32(E), W)
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = 1e9
+    for _ in range(3):
+        t = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t)
+    return best, out
+
+
+@jax.jit
+def empty_loop(x):
+    def body(c):
+        i, x = c
+        return i + 1, x + 1
+    return lax.while_loop(lambda c: c[0] < STEPS, body, (jnp.int32(0), x))
+
+
+@jax.jit
+def col_only(D, e, rmin, er):
+    def body(c):
+        j, D, e, rmin, er = c
+        D, e, rmin, er = _col_step_u(
+            D, e, rmin, er, off, act, rlen, reads_pad, j + 1, off0,
+            jnp.int32(1), wc, et, jnp.int32(E),
+        )
+        return j + 1, D, e, rmin, er
+    return lax.while_loop(
+        lambda c: c[0] < STEPS, body, (jnp.int32(0), D, e, rmin, er)
+    )
+
+
+@jax.jit
+def col_stats(D, e, rmin, er):
+    def body(c):
+        j, D, e, rmin, er, acc = c
+        eds, occ, split, reached = _stats_core_u(
+            D, e, rmin, er, off, act, rlen, reads_pad, j, off0, A,
+            jnp.int32(E),
+        )
+        sym = jnp.argmax(occ.sum(axis=0)).astype(jnp.int32)
+        D, e, rmin, er = _col_step_u(
+            D, e, rmin, er, off, act, rlen, reads_pad, j + 1, off0, sym,
+            wc, et, jnp.int32(E),
+        )
+        return j + 1, D, e, rmin, er, acc + eds.sum()
+    return lax.while_loop(
+        lambda c: c[0] < STEPS, body, (jnp.int32(0), D, e, rmin, er,
+                                       jnp.int32(0))
+    )
+
+
+def chunked(K):
+    @jax.jit
+    def fn(D, e, rmin, er):
+        def one(c):
+            j, D, e, rmin, er, acc = c
+            eds, occ, split, reached = _stats_core_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, j, off0, A,
+                jnp.int32(E),
+            )
+            sym = jnp.argmax(occ.sum(axis=0)).astype(jnp.int32)
+            D, e, rmin, er = _col_step_u(
+                D, e, rmin, er, off, act, rlen, reads_pad, j + 1, off0,
+                sym, wc, et, jnp.int32(E),
+            )
+            return j + 1, D, e, rmin, er, acc + eds.sum()
+
+        def body(c):
+            for _ in range(K):
+                c = one(c)
+            return c
+        return lax.while_loop(
+            lambda c: c[0] < STEPS, body,
+            (jnp.int32(0), D, e, rmin, er, jnp.int32(0)),
+        )
+    return fn
+
+
+def report(name, t):
+    print(f"{name:28s} {t*1e3:8.1f} ms  {t/STEPS*1e6:7.2f} us/step")
+
+
+t, _ = timeit(empty_loop, jnp.int32(0))
+report("empty while_loop", t)
+t, _ = timeit(col_only, D0, e0, rmin0, er0)
+report("col_step only", t)
+t, _ = timeit(col_stats, D0, e0, rmin0, er0)
+report("col_step + stats/vote", t)
+for K in (2, 4, 8, 16):
+    t, _ = timeit(chunked(K), D0, e0, rmin0, er0)
+    report(f"chunked body K={K}", t)

@@ -324,6 +324,21 @@ def _j_mkpad(reads, W: int):
     return jnp.concatenate([fill, reads, fill], axis=1)
 
 
+#: per-dispatch step cap of the fused pallas run (SMEM symbol buffer
+#: rows); a longer run stops with code 4 and the engine re-engages
+_PALLAS_MS_CAP = 32768
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def _j_mkpad_T(reads_pad, rows: int):
+    """Transposed ``[rows, R]`` staging of the padded reads for the
+    fused pallas kernel (band position on sublanes — Mosaic only allows
+    dynamic slicing there), row-padded for the aligned window loads."""
+    R, Lp = reads_pad.shape
+    out = jnp.full((rows, R), -1, reads_pad.dtype)
+    return lax.dynamic_update_slice(out, reads_pad.T, (0, 0))
+
+
 @partial(jax.jit, static_argnames=("new_b",))
 def _j_grow_slots(state, new_b: int):
     """Double the branch-slot axis in one fused dispatch (the eager
@@ -692,11 +707,34 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         (D, e, rmin, er, cons, clen, steps, budget,
          rec_count, rec_steps, rec_fins, _code) = carry
         eds, occ, split, reached = stats_at(D, e, rmin, er, clen)
-        # int32-safe cost total: with L2 and huge per-read distances the
-        # squared sum could wrap, so treat that regime as a host event
+        # finalized snapshot of THIS (pre-push) state: the host records it
+        # at this pop; absorbing the record needs it in-band.  Inlined
+        # ``_finalized`` so its folds ride the packed reductions below.
+        fin_j = jnp.where(act, jnp.minimum(jnp.maximum(e, rmin), INF), 0)
+
+        # ---- packed per-read folds: the loop body's eight [R]-sized
+        # reductions collapse into ONE fused sum and ONE fused max pass
+        # (each separate tiny reduction costs ~1-3us of launch latency per
+        # step, which dominated the measured per-step time).
+        # int32-safe cost totals: with L2 and huge per-read distances the
+        # squared sum could wrap, so treat that regime as a host event.
         costs = jnp.where(l2, eds * eds, eds)
-        total = jnp.where(act, costs, 0).sum()
-        cost_overflow = l2 & (jnp.where(act, eds, 0).max() > 2048)
+        fin_costs = jnp.where(l2, fin_j * fin_j, fin_j)
+        sums = jnp.stack([costs, fin_costs]).sum(axis=1)
+        total, fin_total = sums[0], sums[1]
+
+        nonexact = jnp.where(split > 0, (split & (split - 1)) != 0, False)
+        maxes = jnp.stack([
+            eds,                         # L2 overflow probe (masked)
+            fin_j,                       # fin band-overflow + L2 probe
+            nonexact.astype(jnp.int32),  # vote exactness fold
+            (act & ~reached).astype(jnp.int32),  # early-term completion
+            reached.astype(jnp.int32),   # any-reached fold
+        ]).max(axis=1)
+        cost_overflow = l2 & (maxes[0] > 2048)
+        fin_ovf_j = maxes[1] >= E
+        fin_cost_ovf = l2 & (maxes[1] > 2048)
+        all_exact = maxes[2] == 0
 
         # fractional votes, mirroring the host's candidate nomination: each
         # read splits one unit across its tip symbols.  The host sums in
@@ -704,16 +742,18 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         # whenever the comparison margin exceeds EPS, so we continue only
         # on clear margins (exact when all reads are single-tip).
         EPS = VOTE_EPS
-        voters = occ > 0  # [R, A]
-        has_votes = voters.any(axis=0)
-        n_cands = has_votes.sum()
         frac = jnp.where(
             split[:, None] > 0,
             occ.astype(jnp.float32)
             / jnp.maximum(split, 1)[:, None].astype(jnp.float32),
             0.0,
         )
-        counts = frac.sum(axis=0)  # [A]
+        vsums = jnp.stack(
+            [frac, (occ > 0).astype(jnp.float32)]
+        ).sum(axis=1)  # [2, A]
+        counts = vsums[0]  # [A]
+        has_votes = vsums[1] > 0
+        n_cands = has_votes.sum()
         # wildcard removal (host drops it whenever another candidate exists)
         wc_col = jnp.maximum(wc, 0)
         drop_wc = (wc >= 0) & (n_cands > 1)
@@ -728,11 +768,9 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         passing = has_votes & (counts >= thr)
         npass = passing.sum()
 
-        # exactness: dyadic tip splits make the f32 fold bit-equal to
-        # the host f64 fold (see _dual_votes); only 3-tip reads break it
-        all_exact = (
-            jnp.where(split > 0, (split & (split - 1)) == 0, True)
-        ).all()
+        # exactness (maxes[2] fold above): dyadic tip splits make the f32
+        # fold bit-equal to the host f64 fold (see _dual_votes); only
+        # 3-tip reads break it
         near_tie = (
             (jnp.abs(maxc - min_count_f) < EPS)
             | (has_votes & (jnp.abs(counts - thr) < EPS)).any()
@@ -747,13 +785,7 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         # kernel cannot tell a padding/non-member lane (must not block)
         # from a real inactive read (blocks recording host-side); the
         # host re-checks the real condition at the stop pop.
-        reached_here = jnp.where(et, (reached | ~act).all(), reached.any())
-        # finalized snapshot of THIS (pre-push) state: the host records
-        # it at this pop; absorbing the record needs it in-band
-        fin_j, fin_ovf_j = _finalized(e, rmin, act, E)
-        fin_costs = jnp.where(l2, fin_j * fin_j, fin_j)
-        fin_total = jnp.where(act, fin_costs, 0).sum()
-        fin_cost_ovf = l2 & (jnp.where(act, fin_j, 0).max() > 2048)
+        reached_here = jnp.where(et, maxes[3] == 0, maxes[4] > 0)
         rec_blocked = (
             ~allow_records
             | fin_ovf_j
@@ -2281,6 +2313,15 @@ class JaxScorer(WavefrontScorer):
             self._E = self.INITIAL_E
         self._B = self.INITIAL_SLOTS
         self._C = max(_next_pow2(max_len + 64), self.MIN_C)
+        #: fused-pallas run-loop mode ("tpu" | "interpret" | "off"),
+        #: resolved once; the transposed reads staging is built lazily
+        #: on the first pallas run and dropped on band growth
+        from waffle_con_tpu.ops.pallas_run import pallas_mode
+
+        self._pallas_mode = (
+            pallas_mode() if config.backend != "native" else "off"
+        )
+        self._reads_T_cache = None
         self._stage_reads_pad()
         self._state = self._blank_state()
         #: host mirrors of the per-slot offset/active device state: the
@@ -2328,6 +2369,7 @@ class JaxScorer(WavefrontScorer):
         width is the band width).  ``-1`` filler never matches a symbol
         or the wildcard, and every out-of-range lane is masked anyway."""
         self._reads_pad = _j_mkpad(self._reads, W=self._W)
+        self._reads_T_cache = None  # geometry changed; restage lazily
         if self._shardings is not None and "_reads_pad" in self._shardings:
             self._reads_pad = jax.device_put(
                 self._reads_pad, self._shardings["_reads_pad"]
@@ -2603,6 +2645,31 @@ class JaxScorer(WavefrontScorer):
             self._state, np.asarray([hs, ridx], dtype=np.int32)
         )
 
+    def _pallas_ok(self) -> bool:
+        """Fused-kernel eligibility: mode on + the whole staging fits
+        the VMEM budget at current geometry + the occ output rows cover
+        the alphabet (the kernel emits a fixed 8-row occ block)."""
+        if self._pallas_mode == "off" or self._A > 8:
+            return False
+        from waffle_con_tpu.ops.pallas_run import fits_budget
+
+        return fits_budget(
+            self._reads_T_rows(), self._R, self._W, self._C
+        )
+
+    def _reads_T_rows(self) -> int:
+        from waffle_con_tpu.ops.pallas_run import staging_rows
+
+        return staging_rows(self._reads_pad.shape[1], self._W)
+
+    def _reads_T(self):
+        """Lazily staged transposed reads for the pallas kernel."""
+        if self._reads_T_cache is None:
+            self._reads_T_cache = _j_mkpad_T(
+                self._reads_pad, rows=self._reads_T_rows()
+            )
+        return self._reads_T_cache
+
     def _uniform_off(self, slot: int) -> Tuple[bool, int]:
         """(is_uniform, off0) for a slot's ACTIVE reads, from the host
         mirrors — decides the run kernels' dynamic-slice fast path."""
@@ -2641,6 +2708,15 @@ class JaxScorer(WavefrontScorer):
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
         uniform, off0 = self._uniform_off(slot)
+        use_pallas = uniform and self._pallas_ok()
+        if use_pallas:
+            # fused-kernel path: steps per dispatch bounded by the SMEM
+            # symbol buffer; a capped run stops with code 4 and the
+            # engine simply re-engages (same contract as max_steps)
+            MS = _next_pow2(min(max_steps, _PALLAS_MS_CAP - 2) + 2, 256)
+            max_steps = min(max_steps, MS - 2)
+            while len(consensus) + MS + 2 >= self._C:
+                self._grow_cons()
         params = np.asarray(
             [
                 slot,
@@ -2656,11 +2732,24 @@ class JaxScorer(WavefrontScorer):
             ],
             dtype=np.int32,
         )
-        (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
-         rec_count, rec_steps, rec_fins) = _j_run(
-            self._state, self._reads, self._reads_pad, self._rlen, params,
-            self._wc, self._et, self._A, uniform,
-        )
+        if use_pallas:
+            from waffle_con_tpu.ops.pallas_run import _j_run_pallas
+
+            self.counters["run_pallas_calls"] = (
+                self.counters.get("run_pallas_calls", 0) + 1
+            )
+            (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
+             rec_count, rec_steps, rec_fins) = _j_run_pallas(
+                self._state, self._reads_T(), self._rlen, params,
+                self._wc, self._et, self._A, MS,
+                self._pallas_mode == "interpret",
+            )
+        else:
+            (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
+             rec_count, rec_steps, rec_fins) = _j_run(
+                self._state, self._reads, self._reads_pad, self._rlen,
+                params, self._wc, self._et, self._A, uniform,
+            )
         self._state = state
         (steps, code, stats_np, cons_np, fin_np, fin_ovf,
          rec_count) = jax.device_get(

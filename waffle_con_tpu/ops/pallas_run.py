@@ -1,0 +1,491 @@
+"""Fused Pallas TPU kernel for the single-engine run loop.
+
+``_j_run`` (ops/jax_scorer.py) executes the device-resident multi-symbol
+extension as a ``lax.while_loop`` of ~40 XLA kernels per consensus
+symbol; at north-star scale the measured cost is ~55-80 us/step, almost
+all of it per-kernel launch latency and HBM round-trips (the compiled
+HLO re-copies the full padded reads array HBM->VMEM every iteration).
+This module re-derives the same loop as ONE Mosaic kernel: the whole
+extension runs inside a single ``pl.pallas_call`` with every operand
+pinned in VMEM, so a step is ~40 VPU passes over a [W, R] tile with no
+launch overhead — measured ~10x less wall per step.
+
+Layout is TRANSPOSED relative to the XLA path: the DP tile is
+``D[W, R]`` (band position on sublanes, reads on lanes) because Mosaic
+only allows dynamic slicing on the sublane dimension.  The per-step
+read window is an aligned dynamic sublane load + ``pltpu.roll`` by the
+16-residue, and per-read scalars are natural ``[1, R]`` lane vectors.
+The in-column insertion chain (``lax.cummin`` upstream) is an exact
+log-shift prefix-min over sublanes.
+
+Semantics mirror ``_j_run`` decision-for-decision (stop codes, vote
+EPS contract, record absorption, forced first symbol, band-overflow
+refusal); see that docstring for the contract and
+`/root/reference/src/consensus.rs` for the host search it accelerates.
+Parity is enforced by tests/test_pallas_run.py (interpret mode on CPU)
+and the fuzz/e2e suites with ``WAFFLE_PALLAS=interpret``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from waffle_con_tpu.ops.jax_scorer import INF, REC_CAP, VOTE_EPS
+
+#: sublane alignment of the int16 reads staging array ((16, 128) tiling)
+_ALIGN = 16
+
+#: VMEM budget gate for the whole-array-resident kernel; above this the
+#: caller falls back to the XLA while-loop path
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def pallas_mode() -> str:
+    """``"tpu"`` | ``"interpret"`` | ``"off"`` — resolved once per
+    process from WAFFLE_PALLAS (default: on iff a TPU is attached)."""
+    env = os.environ.get("WAFFLE_PALLAS", "auto")
+    if env == "0":
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover - no backend at all
+        return "off"
+    if platform == "tpu":
+        return "tpu"
+    return "interpret" if env == "1" else "off"
+
+
+def fits_budget(L_pad: int, R: int, W: int, C: int) -> bool:
+    """Conservative VMEM estimate for the resident kernel."""
+    reads = L_pad * R * 2
+    tiles = 6 * W * R * 4  # D + dele/base/chain temporaries
+    rec = REC_CAP * R * 4
+    return reads + tiles + rec + C * 4 < _VMEM_BUDGET
+
+
+def window_block(W: int) -> int:
+    """Sublane extent of one aligned window load (the ONE definition the
+    reads-staging row provisioning must match; see ``staging_rows``)."""
+    return ((W + 2 * _ALIGN - 1) // _ALIGN) * _ALIGN
+
+
+def staging_rows(Lp: int, W: int) -> int:
+    """Row count of the transposed reads staging: ``Lp + window_block``
+    rows guarantee every clipped window load lands in ``-1`` filler."""
+    return ((Lp + window_block(W) + _ALIGN - 1) // _ALIGN) * _ALIGN
+
+
+def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
+    """Build the kernel body for static geometry (W, R, A, E, ...)."""
+    # python scalars (NOT jnp arrays: those would be captured consts,
+    # which pallas kernels reject)
+    INF32 = int(INF)
+    EPS = float(VOTE_EPS)
+
+    if interpret:
+        def roll(x, s):
+            return jnp.roll(x, s, axis=0)
+    else:
+        def roll(x, s):
+            return pltpu.roll(x, s, axis=0)
+
+    def kernel(
+        p_ref, reads_ref, D_ref, e_ref, rmin_ref, er_ref, act_ref,
+        rlen_ref,
+        Do_ref, eo_ref, rmino_ref, ero_ref,
+        eds_ref, occ_ref, split_ref, reached_ref, fin_ref,
+        syms_ref, sc_ref, recs_ref, recf_ref,
+    ):
+        me_budget = p_ref[0]
+        other_cost = p_ref[1]
+        other_len = p_ref[2]
+        min_count = p_ref[3]
+        l2 = p_ref[4] != 0
+        max_steps = p_ref[5]
+        off0 = p_ref[6]
+        first_sym = p_ref[7]
+        allow_records = p_ref[8] != 0
+        clen0 = p_ref[9]
+        wc = p_ref[10]
+        et = p_ref[11] != 0
+
+        act = act_ref[...] != 0        # [1, R]
+        rlen = rlen_ref[...]           # [1, R]
+        tcol = lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+        min_count_f = min_count.astype(jnp.float32)
+
+        def window(clen):
+            """[W, R] int32 read window at consensus position ``clen``
+            (serves both the tip-vote chars at ``clen`` and the column
+            consumed by the push to ``clen+1`` — identical start)."""
+            wstart = W + clen - off0 - E
+            astart = jnp.clip((wstart // _ALIGN) * _ALIGN, 0, Lp - Wb)
+            r = jnp.clip(wstart - astart, 0, Wb)
+            blk = reads_ref[pl.ds(pl.multiple_of(astart, _ALIGN), Wb), :]
+            blk = roll(blk, Wb - r)
+            return blk[0:W, :].astype(jnp.int32)
+
+        def stats_at(D, e, rmin, er, clen, wnd):
+            i = clen - off0 - E + tcol                      # [W, 1]
+            tip = (D <= e) & act & (i >= 0) & (i < rlen)    # [W, R]
+            occ = [
+                jnp.sum(((wnd == a) & tip).astype(jnp.int32), axis=0,
+                        keepdims=True)
+                for a in range(A)
+            ]
+            split = occ[0]
+            for a in range(1, A):
+                split = split + occ[a]
+            reached = act & (er < INF32) & (e == er)
+            eds = jnp.where(act, e, 0)
+            return eds, occ, split, reached
+
+        def col_at(D, e, rmin, er, jnew, sym, wnd):
+            i_new = jnew - off0 - E + tcol                  # [W, 1]
+            sub = ((wnd != sym) & (wnd != wc)).astype(jnp.int32)
+            diag = D + sub
+            dele = jnp.concatenate(
+                [D[1:], jnp.full((1, R), INF32)], axis=0
+            ) + 1
+            base = jnp.minimum(diag, dele)
+            invalid = (i_new < 0) | (i_new > rlen)
+            base = jnp.where(invalid, INF32, base)
+            # exact prefix-min over sublanes (insertion chain)
+            x = base - tcol
+            k = 1
+            while k < W:
+                x = jnp.minimum(
+                    x,
+                    jnp.concatenate(
+                        [jnp.full((k, R), INF32), x[: W - k]], axis=0
+                    ),
+                )
+                k *= 2
+            Dn = jnp.minimum(jnp.minimum(base, x + tcol), INF32)
+            colmin = jnp.min(Dn, axis=0, keepdims=True)
+            rend = jnp.min(
+                jnp.where(i_new == rlen, Dn, INF32), axis=0, keepdims=True
+            )
+            rmin_n = jnp.minimum(rmin, rend)
+            e_unc = jnp.maximum(e, colmin)
+            e_cap = jnp.where(
+                er < INF32,
+                e,
+                jnp.maximum(e, jnp.minimum(colmin, jnp.maximum(e, rmin_n))),
+            )
+            e_n = jnp.where(et, e_cap, e_unc)
+            er_n = jnp.where(
+                er < INF32,
+                er,
+                jnp.where(rmin_n <= e_n, jnp.maximum(e, rmin_n), INF32),
+            )
+            D2 = jnp.where(act, Dn, D)
+            return (
+                D2,
+                jnp.where(act, e_n, e),
+                jnp.where(act, rmin_n, rmin),
+                jnp.where(act, er_n, er),
+            )
+
+        # ---- forced first push (host-nominated child): vote/priority
+        # checks bypassed, only band overflow can refuse it
+        D0 = D_ref[...]
+        e0 = e_ref[...]
+        rmin0 = rmin_ref[...]
+        er0 = er_ref[...]
+        wnd0 = window(clen0)
+        fsym = jnp.maximum(first_sym, 0)
+        Df, ef, rminf, erf = col_at(D0, e0, rmin0, er0, clen0 + 1, fsym,
+                                    wnd0)
+        fovf = jnp.any(act & (ef >= E))
+        do_force = (first_sym >= 0) & ~fovf
+        sel = lambda n, o: jnp.where(do_force, n, o)  # noqa: E731
+        D1, e1, rmin1, er1 = (
+            sel(Df, D0), sel(ef, e0), sel(rminf, rmin0), sel(erf, er0)
+        )
+        clen1 = jnp.where(do_force, clen0 + 1, clen0)
+        steps0 = do_force.astype(jnp.int32)
+        code0 = jnp.where((first_sym >= 0) & fovf, 5, 0).astype(jnp.int32)
+
+        @pl.when(do_force)
+        def _():
+            syms_ref[0] = fsym
+
+        def body(carry):
+            (D, e, rmin, er, clen, steps, budget, rec_count, _code) = carry
+            wnd = window(clen)
+            eds, occ, split, reached = stats_at(D, e, rmin, er, clen, wnd)
+            fin_v = jnp.where(
+                act, jnp.minimum(jnp.maximum(e, rmin), INF32), 0
+            )
+
+            costs = jnp.where(l2, eds * eds, eds)
+            fin_costs = jnp.where(l2, fin_v * fin_v, fin_v)
+            total = jnp.sum(costs)
+            fin_total = jnp.sum(fin_costs)
+            cost_overflow = l2 & (jnp.max(eds) > 2048)
+            fin_max = jnp.max(fin_v)
+            fin_ovf_j = fin_max >= E
+            fin_cost_ovf = l2 & (fin_max > 2048)
+            all_exact = ~jnp.any((split > 0) & ((split & (split - 1)) != 0))
+            reached_here = jnp.where(
+                et, ~jnp.any(act & ~reached), jnp.any(reached)
+            )
+
+            # fractional votes: static per-symbol scalar folds (see
+            # _j_run for the f32-vs-f64 EPS contract)
+            split_f = jnp.maximum(split, 1).astype(jnp.float32)
+            counts = []
+            has_votes = []
+            for a in range(A):
+                frac_a = jnp.where(
+                    split > 0, occ[a].astype(jnp.float32) / split_f, 0.0
+                )
+                counts.append(jnp.sum(frac_a))
+                has_votes.append(jnp.any(occ[a] > 0))
+            n_cands = functools.reduce(
+                lambda x, y: x + y,
+                [hv.astype(jnp.int32) for hv in has_votes],
+            )
+            # wildcard removal (host drops it whenever another candidate
+            # exists); n_cands keeps the PRE-drop count, as in _j_run
+            drop_wc = (wc >= 0) & (n_cands > 1)
+            for a in range(A):
+                is_wc = drop_wc & (wc == a)
+                has_votes[a] = has_votes[a] & ~is_wc
+                counts[a] = jnp.where(is_wc, 0.0, counts[a])
+
+            maxc = jnp.float32(-1.0)
+            for a in range(A):
+                maxc = jnp.maximum(
+                    maxc, jnp.where(has_votes[a], counts[a], -1.0)
+                )
+            thr = jnp.minimum(min_count_f, maxc)
+            npass = jnp.int32(0)
+            near_any = jnp.asarray(False)
+            best = jnp.float32(-1.0)
+            sym = jnp.int32(0)
+            for a in range(A):
+                passing_a = has_votes[a] & (counts[a] >= thr)
+                npass = npass + passing_a.astype(jnp.int32)
+                near_any = near_any | (
+                    has_votes[a] & (jnp.abs(counts[a] - thr) < EPS)
+                )
+                ca = jnp.where(passing_a, counts[a], -1.0)
+                take = ca > best
+                sym = jnp.where(take, a, sym)
+                best = jnp.where(take, ca, best)
+            near_tie = (jnp.abs(maxc - min_count_f) < EPS) | near_any
+            ambiguous = ~all_exact & near_tie
+            dirty = (
+                ambiguous | (npass != 1) | (n_cands == 0) | cost_overflow
+            )
+
+            rec_blocked = (
+                ~allow_records
+                | fin_ovf_j
+                | fin_cost_ovf
+                | (rec_count >= REC_CAP)
+            )
+            wins_pop = (total < other_cost) | (
+                (total == other_cost) & (clen > other_len)
+            )
+            code = jnp.where(
+                (total > budget) | ~wins_pop,
+                3,
+                jnp.where(
+                    reached_here & rec_blocked,
+                    2,
+                    jnp.where(
+                        dirty,
+                        1,
+                        jnp.where(steps >= max_steps, 4, 0),
+                    ),
+                ),
+            ).astype(jnp.int32)
+
+            clen2 = clen + 1
+            D2, e2, rmin2, er2 = col_at(D, e, rmin, er, clen2, sym, wnd)
+            ovf = jnp.any(act & (e2 >= E))
+            commit = (code == 0) & ~ovf
+            code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
+            code = code.astype(jnp.int32)
+
+            @pl.when(commit)
+            def _():
+                syms_ref[steps] = sym
+
+            do_rec = commit & reached_here
+
+            @pl.when(do_rec)
+            def _():
+                ri = jnp.clip(rec_count, 0, REC_CAP - 1)
+                recs_ref[ri] = steps
+                base8 = pl.multiple_of((ri // 8) * 8, 8)
+                blk = recf_ref[pl.ds(base8, 8), :]
+                row = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+                recf_ref[pl.ds(base8, 8), :] = jnp.where(
+                    row == (ri % 8), fin_v, blk
+                )
+
+            rec_count = rec_count + do_rec.astype(jnp.int32)
+            budget = jnp.where(
+                do_rec & (fin_total < budget), fin_total, budget
+            )
+            cm = commit
+            return (
+                jnp.where(cm, D2, D),
+                jnp.where(cm, e2, e),
+                jnp.where(cm, rmin2, rmin),
+                jnp.where(cm, er2, er),
+                jnp.where(cm, clen2, clen),
+                steps + cm.astype(jnp.int32),
+                budget,
+                rec_count,
+                code,
+            )
+
+        (Dn, en, rminn, ern, clen_f, steps, _budget, rec_count,
+         code) = lax.while_loop(
+            lambda c: c[8] == 0,
+            body,
+            (D1, e1, rmin1, er1, clen1, steps0, me_budget, jnp.int32(0),
+             code0),
+        )
+
+        # ---- final snapshot (stats + finalized) and output writeback
+        wndf = window(clen_f)
+        eds, occ, split, reached = stats_at(Dn, en, rminn, ern, clen_f,
+                                            wndf)
+        fin_u = jnp.maximum(en, rminn)
+        fin_masked = jnp.where(act, jnp.minimum(fin_u, INF32), 0)
+        fin_ovf = jnp.any(act & (fin_u >= E))
+
+        Do_ref[...] = Dn
+        eo_ref[...] = en
+        rmino_ref[...] = rminn
+        ero_ref[...] = ern
+        eds_ref[...] = eds
+        occ_ref[...] = jnp.concatenate(
+            occ + [jnp.zeros((8 - A, R), jnp.int32)], axis=0
+        )
+        split_ref[...] = split
+        reached_ref[...] = reached.astype(jnp.int32)
+        fin_ref[...] = fin_masked
+        sc_ref[0] = steps
+        sc_ref[1] = code
+        sc_ref[2] = rec_count
+        sc_ref[3] = fin_ovf.astype(jnp.int32)
+        sc_ref[4] = clen_f
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_symbols", "MS", "interpret"),
+    donate_argnums=(0,),
+)
+def _j_run_pallas(
+    state: Dict[str, Any], reads_T, rlen, params, wc, et,
+    num_symbols: int, MS: int, interpret: bool,
+) -> Tuple:
+    """Drop-in twin of ``_j_run`` backed by the fused kernel (uniform
+    active-offset branches only; the caller guarantees uniformity, the
+    VMEM budget, and ``C >= clen0 + MS``).  Same return tuple as
+    ``_j_run``; ``params`` is the same ``[10] int32`` upload."""
+    h = params[0]
+    W = state["D"].shape[2]
+    R = state["D"].shape[1]
+    C = state["cons"].shape[1]
+    E = int((W - 2) // 2)
+    Lp = reads_T.shape[0]
+    Wb = window_block(W)
+    A = num_symbols
+
+    D0t = state["D"][h].T                       # [W, R]
+    row = lambda a: a.reshape(1, R)             # noqa: E731
+    e0 = row(state["e"][h])
+    rmin0 = row(state["rmin"][h])
+    er0 = row(state["er"][h])
+    act = row(state["act"][h].astype(jnp.int32))
+    rlen2 = row(rlen)
+    clen0 = state["clen"][h]
+    # kernel params: [me_budget, other_cost, other_len, min_count, l2,
+    # max_steps, off0, first_sym, allow_records, clen0, wc, et]
+    p = jnp.concatenate([
+        params[1:10],
+        clen0[None],
+        jnp.asarray(wc, jnp.int32)[None],
+        jnp.asarray(et, jnp.int32)[None],
+    ], axis=0)
+
+    kernel = _mkkernel(
+        W=W, R=R, A=A, E=E, Wb=Wb, Lp=Lp, MS=MS, interpret=interpret
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct((W, R), jnp.int32),    # D
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # e
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # rmin
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # er
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # eds
+        jax.ShapeDtypeStruct((8, R), jnp.int32),    # occ (A rows used)
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # split
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # reached
+        jax.ShapeDtypeStruct((1, R), jnp.int32),    # fin_eds
+        jax.ShapeDtypeStruct((MS,), jnp.int32),     # syms
+        jax.ShapeDtypeStruct((8,), jnp.int32),      # scalars
+        jax.ShapeDtypeStruct((REC_CAP,), jnp.int32),    # rec steps
+        jax.ShapeDtypeStruct((REC_CAP, R), jnp.int32),  # rec fins
+    )
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)  # noqa: E731
+    vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    (Dn, en, rminn, ern, eds, occ8, split, reached, fin_eds, syms,
+     scalars, rec_steps, rec_fins) = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[
+            smem(), vmem(), vmem(), vmem(), vmem(), vmem(), vmem(),
+            vmem(),
+        ],
+        out_specs=(
+            vmem(), vmem(), vmem(), vmem(), vmem(), vmem(), vmem(),
+            vmem(), vmem(), smem(), smem(), smem(), vmem(),
+        ),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(p, reads_T, D0t, e0, rmin0, er0, act, rlen2)
+
+    steps = scalars[0]
+    code = scalars[1]
+    rec_count = scalars[2]
+    fin_ovf = scalars[3].astype(bool)
+    clen_f = scalars[4]
+
+    # caller guarantees clen0 + MS <= C, so the start never clamps
+    cons_row = lax.dynamic_update_slice(state["cons"][h], syms, (clen0,))
+    out = dict(state)
+    out["D"] = state["D"].at[h].set(Dn.T)
+    out["e"] = state["e"].at[h].set(en[0])
+    out["rmin"] = state["rmin"].at[h].set(rminn[0])
+    out["er"] = state["er"].at[h].set(ern[0])
+    out["cons"] = state["cons"].at[h].set(cons_row)
+    out["clen"] = state["clen"].at[h].set(clen_f)
+    stats = (
+        eds[0], occ8[:num_symbols].T, split[0], reached[0].astype(bool)
+    )
+    return (
+        out, steps, code, stats, cons_row, fin_eds[0], fin_ovf,
+        rec_count, rec_steps, rec_fins,
+    )
