@@ -91,6 +91,78 @@ def test_pallas_run_record_absorption():
     assert a[1] in (1, 2, 3, 4)
 
 
+def test_pallas_wildcard_engine_parity():
+    """Wildcard reads through the pallas path: exercises the kernel's
+    wildcard match (sub == 0) and vote-drop scalar folds."""
+    from waffle_con_tpu.models.consensus import ConsensusDWFA
+
+    rng = np.random.default_rng(77)
+    truth, reads = generate_test(4, 150, 6, 0.02, seed=78)
+    star = ord("*")
+    wc_reads = []
+    for r in reads:
+        arr = bytearray(r)
+        for pos in rng.choice(len(arr), size=len(arr) // 15, replace=False):
+            arr[pos] = star
+        wc_reads.append(bytes(arr))
+
+    def run(mode):
+        import waffle_con_tpu.ops.pallas_run as pr
+
+        old = pr.pallas_mode
+        pr.pallas_mode = lambda: mode
+        try:
+            cfg = (
+                CdwfaConfigBuilder().min_count(2).wildcard(star)
+                .backend("jax").build()
+            )
+            eng = ConsensusDWFA(cfg)
+            for r in wc_reads:
+                eng.add_sequence(r)
+            return [(c.sequence, c.scores) for c in eng.consensus()]
+        finally:
+            pr.pallas_mode = old
+
+    assert run("interpret") == run("off")
+
+
+def test_pallas_priority_engine_parity():
+    """Priority chains drive runs at non-zero uniform offsets through
+    SubsetScorer views; the pallas path must match the oracle."""
+    from waffle_con_tpu.models.priority_consensus import (
+        PriorityConsensusDWFA,
+    )
+    from waffle_con_tpu.native import native_priority_consensus
+
+    t0, lvl0 = generate_test(4, 100, 6, 0.01, seed=31)
+    tA = bytes(t0) + b"\x00\x02" * 12
+    tB = bytes(t0) + b"\x01\x03" * 12
+    chains = [[bytes(r), tA] for r in lvl0[:3]] + [
+        [bytes(r), tB] for r in lvl0[3:]
+    ]
+    mk = lambda be: (  # noqa: E731
+        CdwfaConfigBuilder().min_count(2).backend(be).build()
+    )
+    want = native_priority_consensus(chains, config=mk("native"))
+
+    import waffle_con_tpu.ops.pallas_run as pr
+
+    old = pr.pallas_mode
+    pr.pallas_mode = lambda: "interpret"
+    try:
+        eng = PriorityConsensusDWFA(mk("jax"))
+        for ch in chains:
+            eng.add_sequence_chain(ch)
+        got = eng.consensus()
+    finally:
+        pr.pallas_mode = old
+    flat = lambda p: [  # noqa: E731
+        [(c.sequence, c.scores) for c in chain] for chain in p.consensuses
+    ]
+    assert flat(got) == flat(want)
+    assert got.sequence_indices == want.sequence_indices
+
+
 def test_pallas_engine_e2e_parity():
     """Full consensus() through the engine with the pallas scorer path
     (interpret) matches the native oracle byte-for-byte."""

@@ -54,6 +54,8 @@ from __future__ import annotations
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -2648,8 +2650,12 @@ class JaxScorer(WavefrontScorer):
     def _pallas_ok(self) -> bool:
         """Fused-kernel eligibility: mode on + the whole staging fits
         the VMEM budget at current geometry + the occ output rows cover
-        the alphabet (the kernel emits a fixed 8-row occ block)."""
+        the alphabet (the kernel emits a fixed 8-row occ block) + the
+        scorer is unsharded (pallas_call cannot partition GSPMD-sharded
+        operands; the mesh path keeps the XLA while-loop kernels)."""
         if self._pallas_mode == "off" or self._A > 8:
+            return False
+        if self._shardings is not None:
             return False
         from waffle_con_tpu.ops.pallas_run import fits_budget
 
@@ -2733,15 +2739,19 @@ class JaxScorer(WavefrontScorer):
             dtype=np.int32,
         )
         if use_pallas:
-            from waffle_con_tpu.ops.pallas_run import _j_run_pallas
+            from waffle_con_tpu.ops.pallas_run import _j_run_pallas, i16_ok
 
             self.counters["run_pallas_calls"] = (
                 self.counters.get("run_pallas_calls", 0) + 1
             )
+            i16 = (
+                i16_ok(self._L, self._C, self._W)
+                and os.environ.get("WAFFLE_PALLAS_I16", "1") != "0"
+            )
             (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
              rec_count, rec_steps, rec_fins) = _j_run_pallas(
                 self._state, self._reads_T(), self._rlen, params,
-                self._wc, self._et, self._A, MS,
+                self._wc, self._et, self._A, self.num_symbols, MS, i16,
                 self._pallas_mode == "interpret",
             )
         else:

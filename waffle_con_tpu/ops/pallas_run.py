@@ -86,12 +86,31 @@ def staging_rows(Lp: int, W: int) -> int:
     return ((Lp + window_block(W) + _ALIGN - 1) // _ALIGN) * _ALIGN
 
 
-def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
-    """Build the kernel body for static geometry (W, R, A, E, ...)."""
+#: int16 band "infinity": every legitimate finite cell value is gated
+#: below it by ``i16_ok``; anything >= DINF16 maps back to the int32 INF
+DINF16 = 30000
+
+
+def i16_ok(L: int, C: int, W: int) -> bool:
+    """Whether the int16 DP-tile variant is exact at this geometry:
+    a finite cell value is bounded by an edit distance at row <= L,
+    column <= C, i.e. by ``max(L, C)``; the gate adds ``W + 4`` — the
+    in-kernel arithmetic headroom (the +1/+sub steps and the
+    ``x + tcol`` chain term, each < W) plus margin — and requires the
+    total to stay below ``DINF16``."""
+    return max(L, C) + W + 4 < DINF16
+
+
+def _mkkernel(*, W, R, a_real, E, Wb, Lp, MS, i16, interpret):
+    """Build the kernel body for static geometry (W, R, A, E, ...).
+    ``a_real`` is the true dense-symbol count (the [8, R] occ output is
+    zero-padded above it); ``i16`` selects the int16 DP tile."""
     # python scalars (NOT jnp arrays: those would be captured consts,
     # which pallas kernels reject)
     INF32 = int(INF)
     EPS = float(VOTE_EPS)
+    dt = jnp.int16 if i16 else jnp.int32
+    DINF = DINF16 if i16 else INF32
 
     if interpret:
         def roll(x, s):
@@ -123,10 +142,12 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
         act = act_ref[...] != 0        # [1, R]
         rlen = rlen_ref[...]           # [1, R]
         tcol = lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+        tcol_d = tcol.astype(dt)
         min_count_f = min_count.astype(jnp.float32)
+        wc16 = wc.astype(jnp.int16)
 
         def window(clen):
-            """[W, R] int32 read window at consensus position ``clen``
+            """[W, R] int16 read window at consensus position ``clen``
             (serves both the tip-vote chars at ``clen`` and the column
             consumed by the push to ``clen+1`` — identical start)."""
             wstart = W + clen - off0 - E
@@ -134,18 +155,26 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
             r = jnp.clip(wstart - astart, 0, Wb)
             blk = reads_ref[pl.ds(pl.multiple_of(astart, _ALIGN), Wb), :]
             blk = roll(blk, Wb - r)
-            return blk[0:W, :].astype(jnp.int32)
+            return blk[0:W, :]
+
+        def unmap(v):
+            """int32 view of a reduced band value (DINF -> INF)."""
+            v = v.astype(jnp.int32)
+            if not i16:
+                return v
+            return jnp.where(v >= DINF, INF32, v)
 
         def stats_at(D, e, rmin, er, clen, wnd):
             i = clen - off0 - E + tcol                      # [W, 1]
-            tip = (D <= e) & act & (i >= 0) & (i < rlen)    # [W, R]
+            e_d = jnp.minimum(e, DINF).astype(dt)
+            tip = (D <= e_d) & act & (i >= 0) & (i < rlen)  # [W, R]
             occ = [
                 jnp.sum(((wnd == a) & tip).astype(jnp.int32), axis=0,
                         keepdims=True)
-                for a in range(A)
+                for a in range(a_real)
             ]
             split = occ[0]
-            for a in range(1, A):
+            for a in range(1, a_real):
                 split = split + occ[a]
             reached = act & (er < INF32) & (e == er)
             eds = jnp.where(act, e, 0)
@@ -153,30 +182,34 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
 
         def col_at(D, e, rmin, er, jnew, sym, wnd):
             i_new = jnew - off0 - E + tcol                  # [W, 1]
-            sub = ((wnd != sym) & (wnd != wc)).astype(jnp.int32)
+            sub = ((wnd != sym.astype(jnp.int16)) & (wnd != wc16)).astype(dt)
             diag = D + sub
             dele = jnp.concatenate(
-                [D[1:], jnp.full((1, R), INF32)], axis=0
-            ) + 1
+                [D[1:], jnp.full((1, R), DINF, dt)], axis=0
+            ) + jnp.asarray(1, dt)
             base = jnp.minimum(diag, dele)
             invalid = (i_new < 0) | (i_new > rlen)
-            base = jnp.where(invalid, INF32, base)
-            # exact prefix-min over sublanes (insertion chain)
-            x = base - tcol
+            base = jnp.where(invalid, jnp.asarray(DINF, dt), base)
+            # exact prefix-min over sublanes (insertion chain); values
+            # >= DINF are "infinite" either side of the cap below
+            x = base - tcol_d
             k = 1
             while k < W:
                 x = jnp.minimum(
                     x,
                     jnp.concatenate(
-                        [jnp.full((k, R), INF32), x[: W - k]], axis=0
+                        [jnp.full((k, R), DINF, dt), x[: W - k]], axis=0
                     ),
                 )
                 k *= 2
-            Dn = jnp.minimum(jnp.minimum(base, x + tcol), INF32)
-            colmin = jnp.min(Dn, axis=0, keepdims=True)
-            rend = jnp.min(
-                jnp.where(i_new == rlen, Dn, INF32), axis=0, keepdims=True
+            Dn = jnp.minimum(
+                jnp.minimum(base, x + tcol_d), jnp.asarray(DINF, dt)
             )
+            colmin = unmap(jnp.min(Dn, axis=0, keepdims=True))
+            rend = unmap(jnp.min(
+                jnp.where(i_new == rlen, Dn, jnp.asarray(DINF, dt)),
+                axis=0, keepdims=True,
+            ))
             rmin_n = jnp.minimum(rmin, rend)
             e_unc = jnp.maximum(e, colmin)
             e_cap = jnp.where(
@@ -248,7 +281,7 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
             split_f = jnp.maximum(split, 1).astype(jnp.float32)
             counts = []
             has_votes = []
-            for a in range(A):
+            for a in range(a_real):
                 frac_a = jnp.where(
                     split > 0, occ[a].astype(jnp.float32) / split_f, 0.0
                 )
@@ -261,13 +294,13 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
             # wildcard removal (host drops it whenever another candidate
             # exists); n_cands keeps the PRE-drop count, as in _j_run
             drop_wc = (wc >= 0) & (n_cands > 1)
-            for a in range(A):
+            for a in range(a_real):
                 is_wc = drop_wc & (wc == a)
                 has_votes[a] = has_votes[a] & ~is_wc
                 counts[a] = jnp.where(is_wc, 0.0, counts[a])
 
             maxc = jnp.float32(-1.0)
-            for a in range(A):
+            for a in range(a_real):
                 maxc = jnp.maximum(
                     maxc, jnp.where(has_votes[a], counts[a], -1.0)
                 )
@@ -276,7 +309,7 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
             near_any = jnp.asarray(False)
             best = jnp.float32(-1.0)
             sym = jnp.int32(0)
-            for a in range(A):
+            for a in range(a_real):
                 passing_a = has_votes[a] & (counts[a] >= thr)
                 npass = npass + passing_a.astype(jnp.int32)
                 near_any = near_any | (
@@ -378,7 +411,7 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
         ero_ref[...] = ern
         eds_ref[...] = eds
         occ_ref[...] = jnp.concatenate(
-            occ + [jnp.zeros((8 - A, R), jnp.int32)], axis=0
+            occ + [jnp.zeros((8 - a_real, R), jnp.int32)], axis=0
         )
         split_ref[...] = split
         reached_ref[...] = reached.astype(jnp.int32)
@@ -394,17 +427,18 @@ def _mkkernel(*, W, R, A, E, Wb, Lp, MS, interpret):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_symbols", "MS", "interpret"),
+    static_argnames=("num_symbols", "a_real", "MS", "i16", "interpret"),
     donate_argnums=(0,),
 )
 def _j_run_pallas(
     state: Dict[str, Any], reads_T, rlen, params, wc, et,
-    num_symbols: int, MS: int, interpret: bool,
+    num_symbols: int, a_real: int, MS: int, i16: bool, interpret: bool,
 ) -> Tuple:
     """Drop-in twin of ``_j_run`` backed by the fused kernel (uniform
     active-offset branches only; the caller guarantees uniformity, the
-    VMEM budget, and ``C >= clen0 + MS``).  Same return tuple as
-    ``_j_run``; ``params`` is the same ``[10] int32`` upload."""
+    VMEM budget, ``C >= clen0 + MS``, and — when ``i16`` — the
+    ``i16_ok`` value-range gate).  Same return tuple as ``_j_run``;
+    ``params`` is the same ``[10] int32`` upload."""
     h = params[0]
     W = state["D"].shape[2]
     R = state["D"].shape[1]
@@ -412,9 +446,14 @@ def _j_run_pallas(
     E = int((W - 2) // 2)
     Lp = reads_T.shape[0]
     Wb = window_block(W)
-    A = num_symbols
+    dt = jnp.int16 if i16 else jnp.int32
 
     D0t = state["D"][h].T                       # [W, R]
+    if i16:
+        # DINF16 stands in for INF inside the tile; every legitimate
+        # finite value is far below it (i16_ok gate), so the mapping
+        # round-trips exactly
+        D0t = jnp.minimum(D0t, DINF16).astype(dt)
     row = lambda a: a.reshape(1, R)             # noqa: E731
     e0 = row(state["e"][h])
     rmin0 = row(state["rmin"][h])
@@ -432,10 +471,11 @@ def _j_run_pallas(
     ], axis=0)
 
     kernel = _mkkernel(
-        W=W, R=R, A=A, E=E, Wb=Wb, Lp=Lp, MS=MS, interpret=interpret
+        W=W, R=R, a_real=a_real, E=E, Wb=Wb, Lp=Lp, MS=MS,
+        i16=i16, interpret=interpret,
     )
     out_shape = (
-        jax.ShapeDtypeStruct((W, R), jnp.int32),    # D
+        jax.ShapeDtypeStruct((W, R), dt),           # D
         jax.ShapeDtypeStruct((1, R), jnp.int32),    # e
         jax.ShapeDtypeStruct((1, R), jnp.int32),    # rmin
         jax.ShapeDtypeStruct((1, R), jnp.int32),    # er
@@ -475,8 +515,11 @@ def _j_run_pallas(
 
     # caller guarantees clen0 + MS <= C, so the start never clamps
     cons_row = lax.dynamic_update_slice(state["cons"][h], syms, (clen0,))
+    Dn32 = Dn.astype(jnp.int32)
+    if i16:
+        Dn32 = jnp.where(Dn32 >= DINF16, jnp.int32(INF), Dn32)
     out = dict(state)
-    out["D"] = state["D"].at[h].set(Dn.T)
+    out["D"] = state["D"].at[h].set(Dn32.T)
     out["e"] = state["e"].at[h].set(en[0])
     out["rmin"] = state["rmin"].at[h].set(rminn[0])
     out["er"] = state["er"].at[h].set(ern[0])
