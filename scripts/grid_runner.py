@@ -1,7 +1,13 @@
 #!/usr/bin/env python
 """Run the reference criterion grid one config per subprocess, appending
-JSONL lines as they complete (CPU-pinned; survives individual config
-timeouts).  Usage: python scripts/grid_runner.py OUT.jsonl [timeout_s]"""
+JSONL lines as they complete (survives individual config timeouts and
+tunnel flaps; re-running SKIPS configs already measured in OUT.jsonl,
+so interrupted device runs resume where they left off).
+
+Usage: python scripts/grid_runner.py OUT.jsonl [timeout_s] [platform]
+``platform``: cpu (default) pins jax to host CPU; device uses the
+session default backend (the tunneled TPU when attached).
+"""
 
 import json
 import os
@@ -14,13 +20,15 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = r"""
 import json, sys, time
 import jax
-jax.config.update("jax_platforms", "cpu")
+if {cpu!r} == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {root!r})
 from waffle_con_tpu.utils.cache import enable_compilation_cache
 enable_compilation_cache()
 import bench
 out = bench.bench_single({ns}, {sl}, {er})
 out["metric"] = "consensus_4x{sl}x{ns}_{er}"
+out["device_platform"] = jax.devices()[0].platform
 print("GRIDLINE " + json.dumps(out))
 """
 
@@ -28,10 +36,27 @@ print("GRIDLINE " + json.dumps(out))
 def main():
     out_path = sys.argv[1]
     timeout_s = int(sys.argv[2]) if len(sys.argv) > 2 else 1800
+    platform = sys.argv[3] if len(sys.argv) > 3 else "cpu"
+    done = set()
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            for ln in f:
+                try:
+                    d = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if "value" in d:  # only successful lines count as done
+                    done.add(d["metric"])
     for sl in (1000, 10_000):
         for ns in (8, 30):
             for er in (0.0, 0.01, 0.02):
-                code = CHILD.format(root=ROOT, ns=ns, sl=sl, er=er)
+                metric = f"consensus_4x{sl}x{ns}_{er}"
+                if metric in done:
+                    print(metric, "already measured; skipping", flush=True)
+                    continue
+                code = CHILD.format(
+                    root=ROOT, ns=ns, sl=sl, er=er, cpu=platform
+                )
                 t0 = time.time()
                 try:
                     proc = subprocess.run(
@@ -46,13 +71,13 @@ def main():
                             line = json.loads(ln[len("GRIDLINE "):])
                     if line is None:
                         line = {
-                            "metric": f"consensus_4x{sl}x{ns}_{er}",
+                            "metric": metric,
                             "error": f"rc={proc.returncode}: "
                             + (proc.stderr or "")[-300:],
                         }
                 except subprocess.TimeoutExpired:
                     line = {
-                        "metric": f"consensus_4x{sl}x{ns}_{er}",
+                        "metric": metric,
                         "error": f"timeout after {timeout_s}s",
                     }
                 line["runner_wall_s"] = round(time.time() - t0, 1)

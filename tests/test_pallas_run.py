@@ -80,6 +80,18 @@ def test_pallas_run_parity(case):
     assert a == b
 
 
+def test_pallas_int32_tile_parity(monkeypatch):
+    """The int32 DP-tile variant (the fallback when i16_ok rejects a
+    geometry) must stay decision-identical too — every default-config
+    test geometry satisfies i16_ok, so force the int32 tile here."""
+    monkeypatch.setenv("WAFFLE_PALLAS_I16", "0")
+    case = dict(seed=3, err=0.03, et=True, l2=False, ms=150)
+    assert _run_once("off", **case) == _run_once("interpret", **case)
+    dual = dict(seed=43, err=0.02, et=True, l2=False, weighted=True,
+                ms=120)
+    assert _dual_once("off", **dual) == _dual_once("interpret", **dual)
+
+
 def test_pallas_run_record_absorption():
     """Early-reached reads: the kernel buffers records exactly like the
     XLA path (same (step, fin) pairs, same budget shrinking)."""
@@ -89,6 +101,107 @@ def test_pallas_run_record_absorption():
     assert a == b
     # runs long enough to reach read ends -> records must exist in both
     assert a[1] in (1, 2, 3, 4)
+
+
+def _dual_once(mode, *, seed, err, et, l2, weighted, ms, delta=5,
+               imb=2, lock1=False, lock2=False, min_count=3,
+               snps=((40, 1), (90, 2))):
+    rng = np.random.default_rng(seed)
+    t1, reads1 = generate_test(4, 140, 6, err, seed=seed)
+    t2 = bytearray(t1)
+    for pos, shift in snps:
+        t2[pos] = (t2[pos] + shift) % 4
+    from waffle_con_tpu.utils.example_gen import corrupt
+
+    reads2 = [corrupt(bytes(t2), err, rng) for _ in range(6)]
+    reads = list(reads1) + reads2
+    cfg = (
+        CdwfaConfigBuilder()
+        .min_count(min_count)
+        .allow_early_termination(et)
+        .backend("jax")
+        .build()
+    )
+    sc = JaxScorer(reads, cfg)
+    sc._pallas_mode = mode
+    ha = sc.root(np.ones(len(reads), dtype=bool))
+    hb = sc.root(np.ones(len(reads), dtype=bool))
+    out = sc.run_extend_dual(
+        ha, hb, b"", b"",
+        me_budget=2**31 - 1, other_cost=2**31 - 1, other_len=0,
+        min_count=min_count, ed_delta=delta, imb_min=imb, l2=l2,
+        weighted=weighted, max_steps=ms, lock1=lock1, lock2=lock2,
+    )
+    (steps, code, app1, app2, st1, st2, act1, act2, records) = out
+    took = sc.counters.get("run_dual_pallas_calls", 0)
+    assert (took >= 1) == (mode == "interpret")
+    recs = [
+        (s, f1.tolist(), f2.tolist(), a1.tolist(), a2.tolist())
+        for s, f1, f2, a1, a2 in records
+    ]
+    dump = lambda st: (  # noqa: E731
+        st.eds.tolist(), st.occ.tolist(), st.split.tolist(),
+        st.reached.tolist(),
+    )
+    return (steps, code, app1, app2, dump(st1), dump(st2),
+            act1.tolist(), act2.tolist(), recs)
+
+
+DUAL_CASES = [
+    dict(seed=41, err=0.0, et=False, l2=False, weighted=False, ms=120),
+    dict(seed=42, err=0.02, et=False, l2=False, weighted=False, ms=120),
+    dict(seed=43, err=0.02, et=True, l2=False, weighted=True, ms=120),
+    dict(seed=44, err=0.03, et=False, l2=True, weighted=False, ms=100,
+         delta=2),
+    dict(seed=45, err=0.0, et=True, l2=False, weighted=False, ms=160),
+]
+
+
+@pytest.mark.parametrize("case", DUAL_CASES, ids=lambda c: f"seed{c['seed']}")
+def test_pallas_dual_run_parity(case):
+    assert _dual_once("off", **case) == _dual_once("interpret", **case)
+
+
+def test_pallas_dual_engine_parity():
+    """Full dual consensus through the pallas kernels matches the
+    native oracle on a 2-SNP haplotype split."""
+    from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+    from waffle_con_tpu.native import native_dual_consensus
+    from waffle_con_tpu.utils.example_gen import corrupt
+
+    t1, reads1 = generate_test(4, 160, 8, 0.01, seed=51)
+    t2 = bytearray(t1)
+    t2[40] = (t2[40] + 1) % 4
+    t2[120] = (t2[120] + 2) % 4
+    rng = np.random.default_rng(52)
+    reads = list(reads1) + [
+        corrupt(bytes(t2), 0.01, rng) for _ in range(8)
+    ]
+    mk = lambda be: (  # noqa: E731
+        CdwfaConfigBuilder().min_count(2).backend(be).build()
+    )
+    want = native_dual_consensus(reads, config=mk("native"))
+
+    import waffle_con_tpu.ops.pallas_run as pr
+
+    old = pr.pallas_mode
+    pr.pallas_mode = lambda: "interpret"
+    try:
+        eng = DualConsensusDWFA(mk("jax"))
+        for r in reads:
+            eng.add_sequence(r)
+        got = eng.consensus()
+    finally:
+        pr.pallas_mode = old
+    key = lambda res: [  # noqa: E731
+        (
+            d.consensus1.sequence,
+            None if d.consensus2 is None else d.consensus2.sequence,
+            d.is_consensus1,
+        )
+        for d in res
+    ]
+    assert key(got) == key(want)
 
 
 def test_pallas_wildcard_engine_parity():

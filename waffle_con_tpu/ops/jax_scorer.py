@@ -54,6 +54,7 @@ from __future__ import annotations
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
+import logging
 import os
 
 import jax
@@ -80,6 +81,8 @@ VOTE_EPS = np.float32(1e-2)
 #: snapshots of reached states committed through); a run needing more
 #: records than this stops with code 2 and the host continues normally
 REC_CAP = 256
+
+logger = logging.getLogger(__name__)
 
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
@@ -2323,6 +2326,9 @@ class JaxScorer(WavefrontScorer):
         self._pallas_mode = (
             pallas_mode() if config.backend != "native" else "off"
         )
+        #: per-kernel health (1 = single, 2 = dual): a compile failure
+        #: disables only the failing kernel, not the whole fused path
+        self._pallas_kernel_ok = {1: True, 2: True}
         self._reads_T_cache = None
         self._stage_reads_pad()
         self._state = self._blank_state()
@@ -2647,21 +2653,61 @@ class JaxScorer(WavefrontScorer):
             self._state, np.asarray([hs, ridx], dtype=np.int32)
         )
 
-    def _pallas_ok(self) -> bool:
-        """Fused-kernel eligibility: mode on + the whole staging fits
-        the VMEM budget at current geometry + the occ output rows cover
-        the alphabet (the kernel emits a fixed 8-row occ block) + the
-        scorer is unsharded (pallas_call cannot partition GSPMD-sharded
-        operands; the mesh path keeps the XLA while-loop kernels)."""
+    def _pallas_ok(self, sides: int = 1) -> bool:
+        """Fused-kernel eligibility: mode on (and that kernel not
+        individually disabled by an earlier compile failure) + the
+        whole staging fits the VMEM budget at current geometry + the
+        occ output rows cover the alphabet (the kernel emits a fixed
+        8-row occ block) + the scorer is unsharded (pallas_call cannot
+        partition GSPMD-sharded operands; the mesh path keeps the XLA
+        while-loop kernels)."""
         if self._pallas_mode == "off" or self._A > 8:
+            return False
+        if not self._pallas_kernel_ok.get(sides, True):
             return False
         if self._shardings is not None:
             return False
         from waffle_con_tpu.ops.pallas_run import fits_budget
 
         return fits_budget(
-            self._reads_T_rows(), self._R, self._W, self._C
+            self._reads_T_rows(), self._R, self._W, self._C, sides
         )
+
+    def _pallas_prep(self, longest: int, max_steps: int):
+        """Shared pallas dispatch setup: bucket the SMEM symbol-buffer
+        size, cap the per-dispatch steps (a capped run stops with code
+        4 and the engine re-engages), grow the consensus axis to fit,
+        and resolve the DP-tile dtype.  Returns (MS, capped_steps,
+        i16)."""
+        from waffle_con_tpu.ops.pallas_run import i16_ok
+
+        MS = _next_pow2(min(max_steps, _PALLAS_MS_CAP - 2) + 2, 256)
+        while longest + MS + 2 >= self._C:
+            self._grow_cons()
+        i16 = (
+            i16_ok(self._L, self._C, self._W)
+            and os.environ.get("WAFFLE_PALLAS_I16", "1") != "0"
+        )
+        return MS, min(max_steps, MS - 2), i16
+
+    def _pallas_guarded(self, sides: int, fn, *args):
+        """Run a fused-kernel wrapper, bumping its engagement counter;
+        a Mosaic lowering/compile failure must never take the engine
+        down, so on exception the ONE failing kernel is disabled for
+        this scorer and ``None`` signals the caller to fall back to
+        the XLA while-loop path."""
+        key = "run_pallas_calls" if sides == 1 else "run_dual_pallas_calls"
+        try:
+            out = fn(*args)
+        except Exception:
+            logger.warning(
+                "pallas kernel (sides=%d) failed; falling back to the "
+                "XLA path", sides, exc_info=True,
+            )
+            self._pallas_kernel_ok[sides] = False
+            return None
+        self.counters[key] = self.counters.get(key, 0) + 1
+        return out
 
     def _reads_T_rows(self) -> int:
         from waffle_con_tpu.ops.pallas_run import staging_rows
@@ -2714,15 +2760,11 @@ class JaxScorer(WavefrontScorer):
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
         uniform, off0 = self._uniform_off(slot)
-        use_pallas = uniform and self._pallas_ok()
+        use_pallas = uniform and self._pallas_ok(sides=1)
         if use_pallas:
-            # fused-kernel path: steps per dispatch bounded by the SMEM
-            # symbol buffer; a capped run stops with code 4 and the
-            # engine simply re-engages (same contract as max_steps)
-            MS = _next_pow2(min(max_steps, _PALLAS_MS_CAP - 2) + 2, 256)
-            max_steps = min(max_steps, MS - 2)
-            while len(consensus) + MS + 2 >= self._C:
-                self._grow_cons()
+            MS, max_steps, i16 = self._pallas_prep(
+                len(consensus), max_steps
+            )
         params = np.asarray(
             [
                 slot,
@@ -2739,22 +2781,20 @@ class JaxScorer(WavefrontScorer):
             dtype=np.int32,
         )
         if use_pallas:
-            from waffle_con_tpu.ops.pallas_run import _j_run_pallas, i16_ok
+            from waffle_con_tpu.ops.pallas_run import _j_run_pallas
 
-            self.counters["run_pallas_calls"] = (
-                self.counters.get("run_pallas_calls", 0) + 1
-            )
-            i16 = (
-                i16_ok(self._L, self._C, self._W)
-                and os.environ.get("WAFFLE_PALLAS_I16", "1") != "0"
-            )
-            (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
-             rec_count, rec_steps, rec_fins) = _j_run_pallas(
+            out = self._pallas_guarded(
+                1, _j_run_pallas,
                 self._state, self._reads_T(), self._rlen, params,
                 self._wc, self._et, self._A, self.num_symbols, MS, i16,
                 self._pallas_mode == "interpret",
             )
-        else:
+            if out is None:
+                use_pallas = False
+            else:
+                (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
+                 rec_count, rec_steps, rec_fins) = out
+        if not use_pallas:
             (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
              rec_count, rec_steps, rec_fins) = _j_run(
                 self._state, self._reads, self._reads_pad, self._rlen,
@@ -2870,14 +2910,36 @@ class JaxScorer(WavefrontScorer):
             ],
             dtype=np.int32,
         )
-        (state, steps, code, stats1, stats2, act1, act2, consa, consb,
-         rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2) = (
-            _j_run_dual(
+        use_pallas = (uni1 and uni2) and self._pallas_ok(sides=2)
+        if use_pallas:
+            from waffle_con_tpu.ops.pallas_run import _j_run_dual_pallas
+
+            MS, capped, i16 = self._pallas_prep(
+                max(len(consensus1), len(consensus2)), max_steps
+            )
+            params[10] = capped
+            out = self._pallas_guarded(
+                2, _j_run_dual_pallas,
+                self._state, self._reads_T(), self._rlen, params,
+                np.ascontiguousarray(mc_tab, dtype=np.int32),
+                imb_tab, self._wc, self._et, self._A,
+                self.num_symbols, MS, i16,
+                self._pallas_mode == "interpret",
+            )
+            if out is None:
+                use_pallas = False
+            else:
+                (state, steps, code, stats1, stats2, act1, act2, consa,
+                 consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
+                 rec_a2) = out
+        if not use_pallas:
+            (state, steps, code, stats1, stats2, act1, act2, consa,
+             consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
+             rec_a2) = _j_run_dual(
                 self._state, self._reads, self._reads_pad, self._rlen,
                 params, np.ascontiguousarray(mc_tab, dtype=np.int32),
                 imb_tab, self._wc, self._et, self._A, uni1 and uni2,
             )
-        )
         self._state = state
         (steps, code, stats1_np, stats2_np, act1_np, act2_np,
          consa_np, consb_np, rec_count) = jax.device_get(

@@ -66,11 +66,14 @@ def pallas_mode() -> str:
     return "interpret" if env == "1" else "off"
 
 
-def fits_budget(L_pad: int, R: int, W: int, C: int) -> bool:
-    """Conservative VMEM estimate for the resident kernel."""
+def fits_budget(L_pad: int, R: int, W: int, C: int,
+                sides: int = 1) -> bool:
+    """Conservative VMEM estimate for the resident kernel;
+    ``sides=2`` models the dual kernel (two DP tiles in+out, two stats
+    blocks, and four REC_CAP x R record planes instead of one)."""
     reads = L_pad * R * 2
-    tiles = 6 * W * R * 4  # D + dele/base/chain temporaries
-    rec = REC_CAP * R * 4
+    tiles = sides * 6 * W * R * 4  # D + dele/base/chain temporaries
+    rec = (4 if sides == 2 else 1) * REC_CAP * R * 4
     return reads + tiles + rec + C * 4 < _VMEM_BUDGET
 
 
@@ -101,8 +104,118 @@ def i16_ok(L: int, C: int, W: int) -> bool:
     return max(L, C) + W + 4 < DINF16
 
 
+def _roll_fn(interpret):
+    if interpret:
+        return lambda x, s: jnp.roll(x, s, axis=0)
+    return lambda x, s: pltpu.roll(x, s, axis=0)
+
+
+def _band_ops(*, reads_ref, rlen, wc, et, W, R, E, Wb, Lp, a_real, dt,
+              roll):
+    """Shared [W, R]-layout band primitives for the fused kernels.
+
+    ``act`` and ``off0`` are per-call parameters (the dual kernel's
+    active masks evolve via divergence pruning and each side has its
+    own offset); everything else is closed over.  Returns
+    ``(window, unmap, stats_at, col_at)`` — the transposed twins of
+    ``_read_window`` / ``_stats_core_w`` / ``_col_step_w``."""
+    INF32 = int(INF)
+    DINF = DINF16 if dt == jnp.int16 else INF32
+    i16 = dt == jnp.int16
+    tcol = lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+    tcol_d = tcol.astype(dt)
+    wc16 = wc.astype(jnp.int16)
+
+    def window(clen, off0):
+        """[W, R] int16 read window at consensus position ``clen``
+        (serves both the tip-vote chars at ``clen`` and the column
+        consumed by the push to ``clen+1`` — identical start)."""
+        wstart = W + clen - off0 - E
+        astart = jnp.clip((wstart // _ALIGN) * _ALIGN, 0, Lp - Wb)
+        r = jnp.clip(wstart - astart, 0, Wb)
+        blk = reads_ref[pl.ds(pl.multiple_of(astart, _ALIGN), Wb), :]
+        blk = roll(blk, Wb - r)
+        return blk[0:W, :]
+
+    def unmap(v):
+        """int32 view of a reduced band value (DINF -> INF)."""
+        v = v.astype(jnp.int32)
+        if not i16:
+            return v
+        return jnp.where(v >= DINF, INF32, v)
+
+    def stats_at(D, e, rmin, er, act, clen, off0, wnd):
+        i = clen - off0 - E + tcol                      # [W, 1]
+        e_d = jnp.minimum(e, DINF).astype(dt)
+        tip = (D <= e_d) & act & (i >= 0) & (i < rlen)  # [W, R]
+        occ = [
+            jnp.sum(((wnd == a) & tip).astype(jnp.int32), axis=0,
+                    keepdims=True)
+            for a in range(a_real)
+        ]
+        split = occ[0]
+        for a in range(1, a_real):
+            split = split + occ[a]
+        reached = act & (er < INF32) & (e == er)
+        eds = jnp.where(act, e, 0)
+        return eds, occ, split, reached
+
+    def col_at(D, e, rmin, er, act, jnew, off0, sym, wnd):
+        i_new = jnew - off0 - E + tcol                  # [W, 1]
+        sub = ((wnd != sym.astype(jnp.int16)) & (wnd != wc16)).astype(dt)
+        diag = D + sub
+        dele = jnp.concatenate(
+            [D[1:], jnp.full((1, R), DINF, dt)], axis=0
+        ) + jnp.asarray(1, dt)
+        base = jnp.minimum(diag, dele)
+        invalid = (i_new < 0) | (i_new > rlen)
+        base = jnp.where(invalid, jnp.asarray(DINF, dt), base)
+        # exact prefix-min over sublanes (insertion chain); values
+        # >= DINF are "infinite" either side of the cap below
+        x = base - tcol_d
+        k = 1
+        while k < W:
+            x = jnp.minimum(
+                x,
+                jnp.concatenate(
+                    [jnp.full((k, R), DINF, dt), x[: W - k]], axis=0
+                ),
+            )
+            k *= 2
+        Dn = jnp.minimum(
+            jnp.minimum(base, x + tcol_d), jnp.asarray(DINF, dt)
+        )
+        colmin = unmap(jnp.min(Dn, axis=0, keepdims=True))
+        rend = unmap(jnp.min(
+            jnp.where(i_new == rlen, Dn, jnp.asarray(DINF, dt)),
+            axis=0, keepdims=True,
+        ))
+        rmin_n = jnp.minimum(rmin, rend)
+        e_unc = jnp.maximum(e, colmin)
+        e_cap = jnp.where(
+            er < INF32,
+            e,
+            jnp.maximum(e, jnp.minimum(colmin, jnp.maximum(e, rmin_n))),
+        )
+        e_n = jnp.where(et, e_cap, e_unc)
+        er_n = jnp.where(
+            er < INF32,
+            er,
+            jnp.where(rmin_n <= e_n, jnp.maximum(e, rmin_n), INF32),
+        )
+        D2 = jnp.where(act, Dn, D)
+        return (
+            D2,
+            jnp.where(act, e_n, e),
+            jnp.where(act, rmin_n, rmin),
+            jnp.where(act, er_n, er),
+        )
+
+    return window, unmap, stats_at, col_at
+
+
 def _mkkernel(*, W, R, a_real, E, Wb, Lp, MS, i16, interpret):
-    """Build the kernel body for static geometry (W, R, A, E, ...).
+    """Build the single-engine kernel body for static geometry.
     ``a_real`` is the true dense-symbol count (the [8, R] occ output is
     zero-padded above it); ``i16`` selects the int16 DP tile."""
     # python scalars (NOT jnp arrays: those would be captured consts,
@@ -111,13 +224,7 @@ def _mkkernel(*, W, R, a_real, E, Wb, Lp, MS, i16, interpret):
     EPS = float(VOTE_EPS)
     dt = jnp.int16 if i16 else jnp.int32
     DINF = DINF16 if i16 else INF32
-
-    if interpret:
-        def roll(x, s):
-            return jnp.roll(x, s, axis=0)
-    else:
-        def roll(x, s):
-            return pltpu.roll(x, s, axis=0)
+    roll = _roll_fn(interpret)
 
     def kernel(
         p_ref, reads_ref, D_ref, e_ref, rmin_ref, er_ref, act_ref,
@@ -139,97 +246,22 @@ def _mkkernel(*, W, R, a_real, E, Wb, Lp, MS, i16, interpret):
         wc = p_ref[10]
         et = p_ref[11] != 0
 
-        act = act_ref[...] != 0        # [1, R]
+        act0 = act_ref[...] != 0       # [1, R] (fixed for this kernel)
         rlen = rlen_ref[...]           # [1, R]
-        tcol = lax.broadcasted_iota(jnp.int32, (W, 1), 0)
-        tcol_d = tcol.astype(dt)
         min_count_f = min_count.astype(jnp.float32)
-        wc16 = wc.astype(jnp.int16)
 
-        def window(clen):
-            """[W, R] int16 read window at consensus position ``clen``
-            (serves both the tip-vote chars at ``clen`` and the column
-            consumed by the push to ``clen+1`` — identical start)."""
-            wstart = W + clen - off0 - E
-            astart = jnp.clip((wstart // _ALIGN) * _ALIGN, 0, Lp - Wb)
-            r = jnp.clip(wstart - astart, 0, Wb)
-            blk = reads_ref[pl.ds(pl.multiple_of(astart, _ALIGN), Wb), :]
-            blk = roll(blk, Wb - r)
-            return blk[0:W, :]
-
-        def unmap(v):
-            """int32 view of a reduced band value (DINF -> INF)."""
-            v = v.astype(jnp.int32)
-            if not i16:
-                return v
-            return jnp.where(v >= DINF, INF32, v)
-
-        def stats_at(D, e, rmin, er, clen, wnd):
-            i = clen - off0 - E + tcol                      # [W, 1]
-            e_d = jnp.minimum(e, DINF).astype(dt)
-            tip = (D <= e_d) & act & (i >= 0) & (i < rlen)  # [W, R]
-            occ = [
-                jnp.sum(((wnd == a) & tip).astype(jnp.int32), axis=0,
-                        keepdims=True)
-                for a in range(a_real)
-            ]
-            split = occ[0]
-            for a in range(1, a_real):
-                split = split + occ[a]
-            reached = act & (er < INF32) & (e == er)
-            eds = jnp.where(act, e, 0)
-            return eds, occ, split, reached
-
-        def col_at(D, e, rmin, er, jnew, sym, wnd):
-            i_new = jnew - off0 - E + tcol                  # [W, 1]
-            sub = ((wnd != sym.astype(jnp.int16)) & (wnd != wc16)).astype(dt)
-            diag = D + sub
-            dele = jnp.concatenate(
-                [D[1:], jnp.full((1, R), DINF, dt)], axis=0
-            ) + jnp.asarray(1, dt)
-            base = jnp.minimum(diag, dele)
-            invalid = (i_new < 0) | (i_new > rlen)
-            base = jnp.where(invalid, jnp.asarray(DINF, dt), base)
-            # exact prefix-min over sublanes (insertion chain); values
-            # >= DINF are "infinite" either side of the cap below
-            x = base - tcol_d
-            k = 1
-            while k < W:
-                x = jnp.minimum(
-                    x,
-                    jnp.concatenate(
-                        [jnp.full((k, R), DINF, dt), x[: W - k]], axis=0
-                    ),
-                )
-                k *= 2
-            Dn = jnp.minimum(
-                jnp.minimum(base, x + tcol_d), jnp.asarray(DINF, dt)
-            )
-            colmin = unmap(jnp.min(Dn, axis=0, keepdims=True))
-            rend = unmap(jnp.min(
-                jnp.where(i_new == rlen, Dn, jnp.asarray(DINF, dt)),
-                axis=0, keepdims=True,
-            ))
-            rmin_n = jnp.minimum(rmin, rend)
-            e_unc = jnp.maximum(e, colmin)
-            e_cap = jnp.where(
-                er < INF32,
-                e,
-                jnp.maximum(e, jnp.minimum(colmin, jnp.maximum(e, rmin_n))),
-            )
-            e_n = jnp.where(et, e_cap, e_unc)
-            er_n = jnp.where(
-                er < INF32,
-                er,
-                jnp.where(rmin_n <= e_n, jnp.maximum(e, rmin_n), INF32),
-            )
-            D2 = jnp.where(act, Dn, D)
-            return (
-                D2,
-                jnp.where(act, e_n, e),
-                jnp.where(act, rmin_n, rmin),
-                jnp.where(act, er_n, er),
-            )
+        _window, unmap, _stats_at, _col_at = _band_ops(
+            reads_ref=reads_ref, rlen=rlen, wc=wc, et=et, W=W, R=R, E=E,
+            Wb=Wb, Lp=Lp, a_real=a_real, dt=dt, roll=roll,
+        )
+        act = act0
+        window = lambda clen: _window(clen, off0)  # noqa: E731
+        stats_at = lambda D, e, rmin, er, clen, wnd: _stats_at(  # noqa: E731
+            D, e, rmin, er, act, clen, off0, wnd
+        )
+        col_at = lambda D, e, rmin, er, jnew, sym, wnd: _col_at(  # noqa: E731
+            D, e, rmin, er, act, jnew, off0, sym, wnd
+        )
 
         # ---- forced first push (host-nominated child): vote/priority
         # checks bypassed, only band overflow can refuse it
@@ -531,4 +563,508 @@ def _j_run_pallas(
     return (
         out, steps, code, stats, cons_row, fin_eds[0], fin_ovf,
         rec_count, rec_steps, rec_fins,
+    )
+
+
+def _mkkernel_dual(*, W, R, a_real, E, Wb, Lp, MS, MCN, IMBN, i16,
+                   interpret):
+    """Dual twin of :func:`_mkkernel`: both sides advance one symbol per
+    iteration with per-side nomination (``_nominate_side`` semantics,
+    including the dynamic min-count table), locks, divergence pruning,
+    the imbalance table, and two-side record absorption — mirroring
+    ``_j_run_dual`` decision-for-decision."""
+    INF32 = int(INF)
+    EPS = float(VOTE_EPS)
+    BIG = 1 << 28
+    dt = jnp.int16 if i16 else jnp.int32
+    roll = _roll_fn(interpret)
+
+    def kernel(
+        p_ref, mc_ref, imb_ref, reads_ref,
+        Da_ref, ea_ref, rmina_ref, era_ref, acta_ref,
+        Db_ref, eb_ref, rminb_ref, erb_ref, actb_ref,
+        rlen_ref,
+        Dao_ref, eao_ref, rminao_ref, erao_ref, actao_ref,
+        Dbo_ref, ebo_ref, rminbo_ref, erbo_ref, actbo_ref,
+        edsa_ref, occa_ref, splita_ref, reacheda_ref,
+        edsb_ref, occb_ref, splitb_ref, reachedb_ref,
+        symsa_ref, symsb_ref, sc_ref, recs_ref,
+        recf1_ref, recf2_ref, reca1_ref, reca2_ref,
+    ):
+        me_budget = p_ref[0]
+        other_cost = p_ref[1]
+        other_len = p_ref[2]
+        min_count = p_ref[3]
+        delta = p_ref[4]
+        # p_ref[5] (imb_min) is host-side only, as in _j_run_dual
+        l2 = p_ref[6] != 0
+        weighted = p_ref[7] != 0
+        max_steps = p_ref[8]
+        off0a = p_ref[9]
+        off0b = p_ref[10]
+        lock_a = p_ref[11] != 0
+        lock_b = p_ref[12] != 0
+        allow_records = p_ref[13] != 0
+        rec_min = p_ref[14]
+        mc_dyn = p_ref[15] != 0
+        clen0a = p_ref[16]
+        clen0b = p_ref[17]
+        wc = p_ref[18]
+        et = p_ref[19] != 0
+
+        rlen = rlen_ref[...]
+        window, unmap, stats_at, col_at = _band_ops(
+            reads_ref=reads_ref, rlen=rlen, wc=wc, et=et, W=W, R=R, E=E,
+            Wb=Wb, Lp=Lp, a_real=a_real, dt=dt, roll=roll,
+        )
+
+        def nominate(occ, split, w):
+            """_dual_votes + _nominate_side as static scalar folds."""
+            voting = (w > 0) & (split > 0)
+            split_f = jnp.maximum(split, 1).astype(jnp.float32)
+            counts = []
+            has_votes = []
+            for a in range(a_real):
+                voters_a = (occ[a] > 0) & voting
+                frac_a = jnp.where(
+                    split > 0, occ[a].astype(jnp.float32) / split_f, 0.0
+                ) * w
+                counts.append(jnp.sum(jnp.where(voters_a, frac_a, 0.0)))
+                has_votes.append(jnp.any(voters_a))
+            n_cands = functools.reduce(
+                lambda x, y: x + y,
+                [hv.astype(jnp.int32) for hv in has_votes],
+            )
+            drop_wc = (wc >= 0) & (n_cands > 1)
+            for a in range(a_real):
+                is_wc = drop_wc & (wc == a)
+                has_votes[a] = has_votes[a] & ~is_wc
+                counts[a] = jnp.where(is_wc, 0.0, counts[a])
+            # dual semantics recount candidates AFTER the wildcard drop
+            n_cands = functools.reduce(
+                lambda x, y: x + y,
+                [hv.astype(jnp.int32) for hv in has_votes],
+            )
+            dyadic = (split & (split - 1)) == 0
+            exactable = ~jnp.any(voting & ~dyadic) & ~weighted
+
+            n_vote_f = functools.reduce(lambda x, y: x + y, counts)
+            n_vote_r = jnp.round(n_vote_f)
+            int_ok = jnp.abs(n_vote_f - n_vote_r) < EPS
+            tab_bad = mc_dyn & ~int_ok
+            exactable = exactable & ~tab_bad
+            mc = mc_ref[jnp.clip(n_vote_r.astype(jnp.int32), 0, MCN - 1)]
+            mc_f = mc.astype(jnp.float32)
+            maxc = jnp.float32(-1.0)
+            for a in range(a_real):
+                maxc = jnp.maximum(
+                    maxc, jnp.where(has_votes[a], counts[a], -1.0)
+                )
+            thr = jnp.minimum(mc_f, maxc)
+            npass = jnp.int32(0)
+            near_any = jnp.asarray(False)
+            best = jnp.float32(-1.0)
+            sym = jnp.int32(0)
+            for a in range(a_real):
+                passing_a = has_votes[a] & (counts[a] >= thr)
+                npass = npass + passing_a.astype(jnp.int32)
+                near_any = near_any | (
+                    has_votes[a] & (jnp.abs(counts[a] - thr) < EPS)
+                )
+                ca = jnp.where(passing_a, counts[a], -1.0)
+                take = ca > best
+                sym = jnp.where(take, a, sym)
+                best = jnp.where(take, ca, best)
+            near_tie = (jnp.abs(maxc - mc_f) < EPS) | near_any
+            ambiguous = ~exactable & near_tie
+            dirty = (
+                ambiguous | (npass != 1) | (n_cands == 0) | tab_bad
+            )
+            return dirty, sym
+
+        def body(carry):
+            (Da, ea, rmina, era, acta, clena,
+             Db, eb, rminb, erb, actb, clenb,
+             steps, budget, rec_count, _code) = carry
+            wnda = window(clena, off0a)
+            wndb = window(clenb, off0b)
+            edsa, occa, splita, reacheda = stats_at(
+                Da, ea, rmina, era, acta, clena, off0a, wnda
+            )
+            edsb, occb, splitb, reachedb = stats_at(
+                Db, eb, rminb, erb, actb, clenb, off0b, wndb
+            )
+
+            # total node cost = per read, best over its tracked sides
+            ca_c = jnp.where(l2, edsa * edsa, edsa)
+            cb_c = jnp.where(l2, edsb * edsb, edsb)
+            best_c = jnp.minimum(
+                jnp.where(acta, ca_c, BIG), jnp.where(actb, cb_c, BIG)
+            )
+            total = jnp.sum(jnp.where(acta | actb, best_c, 0))
+            cost_overflow = l2 & (
+                jnp.maximum(
+                    jnp.max(jnp.where(acta, edsa, 0)),
+                    jnp.max(jnp.where(actb, edsb, 0)),
+                )
+                > 2048
+            )
+
+            # per-read vote weights (reference get_ed_weights semantics;
+            # unweighted nomination uses full weight per tracked read)
+            both = acta & actb
+            c1f = jnp.maximum(edsa.astype(jnp.float32), 0.5)
+            c2f = jnp.maximum(edsb.astype(jnp.float32), 0.5)
+            denom = c1f + c2f
+            wa_soft = jnp.where(
+                both, c2f / denom, jnp.where(acta, 1.0, 0.0)
+            )
+            wb_soft = jnp.where(
+                both, c1f / denom, jnp.where(actb, 1.0, 0.0)
+            )
+            wa = jnp.where(weighted, wa_soft, jnp.where(acta, 1.0, 0.0))
+            wb = jnp.where(weighted, wb_soft, jnp.where(actb, 1.0, 0.0))
+
+            dirty_a, sym_a = nominate(occa, splita, wa)
+            dirty_b, sym_b = nominate(occb, splitb, wb)
+            # a locked side never arbitrates
+            dirty_a = dirty_a & ~lock_a
+            dirty_b = dirty_b & ~lock_b
+
+            reached_read = (acta & reacheda) | (actb & reachedb)
+            fin_a = jnp.where(
+                et, ~jnp.any(~(reacheda | ~acta)),
+                jnp.any(acta & reacheda),
+            )
+            fin_b = jnp.where(
+                et, ~jnp.any(~(reachedb | ~actb)),
+                jnp.any(actb & reachedb),
+            )
+            # CONSERVATIVE completion fold (see _j_run_dual)
+            reached_stop = jnp.where(
+                et, ~jnp.any(~(reached_read | (~acta & ~actb))),
+                jnp.any(reached_read),
+            )
+            cur_len = jnp.maximum(clena, clenb)
+            wins_pop = (total < other_cost) | (
+                (total == other_cost) & (cur_len > other_len)
+            )
+
+            # record eval of THIS (pre-push) state (_finalize mirror)
+            fu1 = jnp.maximum(ea, rmina)
+            fu2 = jnp.maximum(eb, rminb)
+            fo1 = jnp.any(acta & (fu1 >= E))
+            fo2 = jnp.any(actb & (fu2 >= E))
+            fin1_j = jnp.where(acta, jnp.minimum(fu1, INF32), 0)
+            fin2_j = jnp.where(actb, jnp.minimum(fu2, INF32), 0)
+            fc1 = jnp.where(l2, fin1_j * fin1_j, fin1_j)
+            fc2 = jnp.where(l2, fin2_j * fin2_j, fin2_j)
+            side0 = acta & (~actb | (fc1 <= fc2))
+            any_act = acta | actb
+            fin_total = jnp.sum(
+                jnp.where(any_act, jnp.where(side0, fc1, fc2), 0)
+            )
+            count0 = jnp.sum((side0 & any_act).astype(jnp.int32))
+            count1 = jnp.sum(any_act.astype(jnp.int32)) - count0
+            rec_imbalanced = (count0 < rec_min) | (count1 < rec_min)
+            fin_cost_ovf = l2 & (
+                jnp.maximum(
+                    jnp.max(jnp.where(acta, fin1_j, 0)),
+                    jnp.max(jnp.where(actb, fin2_j, 0)),
+                )
+                > 2048
+            )
+            rec_blocked = (
+                ~allow_records | fo1 | fo2 | fin_cost_ovf
+                | (rec_count >= REC_CAP)
+            )
+
+            code = jnp.where(
+                (total > budget) | ~wins_pop,
+                3,
+                jnp.where(
+                    reached_stop & rec_blocked,
+                    2,
+                    jnp.where(
+                        dirty_a
+                        | dirty_b
+                        | (fin_a & ~lock_a)
+                        | (fin_b & ~lock_b)
+                        | cost_overflow,
+                        1,
+                        jnp.where(steps >= max_steps, 4, 0),
+                    ),
+                ),
+            ).astype(jnp.int32)
+
+            Da2, ea2, rmina2, era2 = col_at(
+                Da, ea, rmina, era, acta, clena + 1, off0a, sym_a, wnda
+            )
+            Db2, eb2, rminb2, erb2 = col_at(
+                Db, eb, rminb, erb, actb, clenb + 1, off0b, sym_b, wndb
+            )
+            # locked sides are frozen: discard their column step
+            frz = lambda lock, new, old: jnp.where(lock, old, new)  # noqa: E731
+            Da2 = frz(lock_a, Da2, Da)
+            ea2 = frz(lock_a, ea2, ea)
+            rmina2 = frz(lock_a, rmina2, rmina)
+            era2 = frz(lock_a, era2, era)
+            Db2 = frz(lock_b, Db2, Db)
+            eb2 = frz(lock_b, eb2, eb)
+            rminb2 = frz(lock_b, rminb2, rminb)
+            erb2 = frz(lock_b, erb2, erb)
+            ovf = jnp.any((acta & (ea2 >= E)) | (actb & (eb2 >= E)))
+
+            # divergence pruning on post-push distances
+            both2 = acta & actb
+            acta2 = acta & ~(both2 & (eb2 + delta < ea2))
+            actb2 = actb & ~(both2 & (ea2 + delta < eb2))
+            imb_v = imb_ref[jnp.clip(cur_len + 1, 0, IMBN - 1)]
+            imb = (
+                jnp.sum(acta2.astype(jnp.int32)) < imb_v
+            ) | (jnp.sum(actb2.astype(jnp.int32)) < imb_v)
+
+            commit = (code == 0) & ~ovf
+            code = jnp.where(
+                code != 0,
+                code,
+                jnp.where(ovf, 5, jnp.where(imb, 6, 0)),
+            ).astype(jnp.int32)
+
+            @pl.when(commit & ~lock_a)
+            def _():
+                symsa_ref[steps] = sym_a
+
+            @pl.when(commit & ~lock_b)
+            def _():
+                symsb_ref[steps] = sym_b
+
+            do_rec = commit & reached_stop
+
+            @pl.when(do_rec)
+            def _():
+                ri = jnp.clip(rec_count, 0, REC_CAP - 1)
+                recs_ref[ri] = steps
+                base8 = pl.multiple_of((ri // 8) * 8, 8)
+                row = lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+                mask = row == (ri % 8)
+                for ref, val in (
+                    (recf1_ref, fin1_j),
+                    (recf2_ref, fin2_j),
+                    (reca1_ref, acta.astype(jnp.int32)),
+                    (reca2_ref, actb.astype(jnp.int32)),
+                ):
+                    blk = ref[pl.ds(base8, 8), :]
+                    ref[pl.ds(base8, 8), :] = jnp.where(mask, val, blk)
+
+            rec_count = rec_count + do_rec.astype(jnp.int32)
+            budget = jnp.where(
+                do_rec & ~rec_imbalanced & (fin_total < budget),
+                fin_total,
+                budget,
+            )
+            cm = commit
+            sel = lambda new, old: jnp.where(cm, new, old)  # noqa: E731
+            return (
+                sel(Da2, Da), sel(ea2, ea), sel(rmina2, rmina),
+                sel(era2, era), sel(acta2, acta),
+                jnp.where(cm & ~lock_a, clena + 1, clena),
+                sel(Db2, Db), sel(eb2, eb), sel(rminb2, rminb),
+                sel(erb2, erb), sel(actb2, actb),
+                jnp.where(cm & ~lock_b, clenb + 1, clenb),
+                steps + cm.astype(jnp.int32),
+                budget,
+                rec_count,
+                code,
+            )
+
+        init = (
+            Da_ref[...], ea_ref[...], rmina_ref[...], era_ref[...],
+            acta_ref[...] != 0, clen0a,
+            Db_ref[...], eb_ref[...], rminb_ref[...], erb_ref[...],
+            actb_ref[...] != 0, clen0b,
+            jnp.int32(0), me_budget, jnp.int32(0), jnp.int32(0),
+        )
+        (Da, ea, rmina, era, acta, clena,
+         Db, eb, rminb, erb, actb, clenb,
+         steps, _budget, rec_count, code) = lax.while_loop(
+            lambda c: c[15] == 0, body, init
+        )
+
+        wnda = window(clena, off0a)
+        wndb = window(clenb, off0b)
+        edsa, occa, splita, reacheda = stats_at(
+            Da, ea, rmina, era, acta, clena, off0a, wnda
+        )
+        edsb, occb, splitb, reachedb = stats_at(
+            Db, eb, rminb, erb, actb, clenb, off0b, wndb
+        )
+
+        pad = [jnp.zeros((8 - a_real, R), jnp.int32)]
+        Dao_ref[...] = Da
+        eao_ref[...] = ea
+        rminao_ref[...] = rmina
+        erao_ref[...] = era
+        actao_ref[...] = acta.astype(jnp.int32)
+        Dbo_ref[...] = Db
+        ebo_ref[...] = eb
+        rminbo_ref[...] = rminb
+        erbo_ref[...] = erb
+        actbo_ref[...] = actb.astype(jnp.int32)
+        edsa_ref[...] = edsa
+        occa_ref[...] = jnp.concatenate(occa + pad, axis=0)
+        splita_ref[...] = splita
+        reacheda_ref[...] = reacheda.astype(jnp.int32)
+        edsb_ref[...] = edsb
+        occb_ref[...] = jnp.concatenate(occb + pad, axis=0)
+        splitb_ref[...] = splitb
+        reachedb_ref[...] = reachedb.astype(jnp.int32)
+        sc_ref[0] = steps
+        sc_ref[1] = code
+        sc_ref[2] = rec_count
+        sc_ref[3] = clena
+        sc_ref[4] = clenb
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_symbols", "a_real", "MS", "i16", "interpret"),
+    donate_argnums=(0,),
+)
+def _j_run_dual_pallas(
+    state: Dict[str, Any], reads_T, rlen, params, mc_tab, imb_tab, wc,
+    et, num_symbols: int, a_real: int, MS: int, i16: bool,
+    interpret: bool,
+) -> Tuple:
+    """Drop-in twin of ``_j_run_dual`` backed by the fused dual kernel
+    (uniform offsets both sides; caller guarantees the VMEM budget and
+    ``C >= max(clen0) + MS``).  Same return tuple as ``_j_run_dual``;
+    ``params`` is the same ``[18] int32`` upload."""
+    ha = params[0]
+    hb = params[1]
+    W = state["D"].shape[2]
+    R = state["D"].shape[1]
+    E = int((W - 2) // 2)
+    Lp = reads_T.shape[0]
+    Wb = window_block(W)
+    dt = jnp.int16 if i16 else jnp.int32
+
+    def side(h):
+        D = state["D"][h].T
+        if i16:
+            D = jnp.minimum(D, DINF16).astype(dt)
+        return (
+            D,
+            state["e"][h].reshape(1, R),
+            state["rmin"][h].reshape(1, R),
+            state["er"][h].reshape(1, R),
+            state["act"][h].astype(jnp.int32).reshape(1, R),
+        )
+
+    Da0, ea0, rmina0, era0, acta0 = side(ha)
+    Db0, eb0, rminb0, erb0, actb0 = side(hb)
+    clen0a = state["clen"][ha]
+    clen0b = state["clen"][hb]
+    # kernel params: _j_run_dual's params[2:18] + clen0a/b + wc + et
+    p = jnp.concatenate([
+        params[2:18],
+        clen0a[None],
+        clen0b[None],
+        jnp.asarray(wc, jnp.int32)[None],
+        jnp.asarray(et, jnp.int32)[None],
+    ], axis=0)
+
+    kernel = _mkkernel_dual(
+        W=W, R=R, a_real=a_real, E=E, Wb=Wb, Lp=Lp, MS=MS,
+        MCN=int(mc_tab.shape[0]), IMBN=int(imb_tab.shape[0]), i16=i16,
+        interpret=interpret,
+    )
+    vec = lambda: jax.ShapeDtypeStruct((1, R), jnp.int32)  # noqa: E731
+    out_shape = (
+        jax.ShapeDtypeStruct((W, R), dt), vec(), vec(), vec(), vec(),
+        jax.ShapeDtypeStruct((W, R), dt), vec(), vec(), vec(), vec(),
+        vec(), jax.ShapeDtypeStruct((8, R), jnp.int32), vec(), vec(),
+        vec(), jax.ShapeDtypeStruct((8, R), jnp.int32), vec(), vec(),
+        jax.ShapeDtypeStruct((MS,), jnp.int32),
+        jax.ShapeDtypeStruct((MS,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((REC_CAP,), jnp.int32),
+        jax.ShapeDtypeStruct((REC_CAP, R), jnp.int32),
+        jax.ShapeDtypeStruct((REC_CAP, R), jnp.int32),
+        jax.ShapeDtypeStruct((REC_CAP, R), jnp.int32),
+        jax.ShapeDtypeStruct((REC_CAP, R), jnp.int32),
+    )
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)  # noqa: E731
+    vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    (Da, ea, rmina, era, acta, Db, eb, rminb, erb, actb,
+     edsa, occa8, splita, reacheda, edsb, occb8, splitb, reachedb,
+     symsa, symsb, scalars, rec_steps, rec_f1, rec_f2, rec_a1,
+     rec_a2) = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[
+            smem(), smem(), smem(), vmem(),
+            vmem(), vmem(), vmem(), vmem(), vmem(),
+            vmem(), vmem(), vmem(), vmem(), vmem(),
+            vmem(),
+        ],
+        out_specs=(
+            vmem(), vmem(), vmem(), vmem(), vmem(),
+            vmem(), vmem(), vmem(), vmem(), vmem(),
+            vmem(), vmem(), vmem(), vmem(),
+            vmem(), vmem(), vmem(), vmem(),
+            smem(), smem(), smem(), smem(),
+            vmem(), vmem(), vmem(), vmem(),
+        ),
+        input_output_aliases={4: 0, 9: 5},
+        interpret=interpret,
+    )(p, mc_tab, imb_tab, reads_T,
+      Da0, ea0, rmina0, era0, acta0,
+      Db0, eb0, rminb0, erb0, actb0,
+      rlen.reshape(1, R))
+
+    steps = scalars[0]
+    code = scalars[1]
+    rec_count = scalars[2]
+    clena_f = scalars[3]
+    clenb_f = scalars[4]
+
+    def unmapD(D):
+        D32 = D.astype(jnp.int32)
+        if i16:
+            D32 = jnp.where(D32 >= DINF16, jnp.int32(INF), D32)
+        return D32.T
+
+    consa_row = lax.dynamic_update_slice(
+        state["cons"][ha], symsa, (clen0a,)
+    )
+    consb_row = lax.dynamic_update_slice(
+        state["cons"][hb], symsb, (clen0b,)
+    )
+    acta_b = acta[0].astype(bool)
+    actb_b = actb[0].astype(bool)
+    out = dict(state)
+    out["D"] = state["D"].at[ha].set(unmapD(Da)).at[hb].set(unmapD(Db))
+    out["e"] = state["e"].at[ha].set(ea[0]).at[hb].set(eb[0])
+    out["rmin"] = state["rmin"].at[ha].set(rmina[0]).at[hb].set(rminb[0])
+    out["er"] = state["er"].at[ha].set(era[0]).at[hb].set(erb[0])
+    out["act"] = state["act"].at[ha].set(acta_b).at[hb].set(actb_b)
+    out["cons"] = (
+        state["cons"].at[ha].set(consa_row).at[hb].set(consb_row)
+    )
+    out["clen"] = state["clen"].at[ha].set(clena_f).at[hb].set(clenb_f)
+    stats_a = (
+        edsa[0], occa8[:num_symbols].T, splita[0],
+        reacheda[0].astype(bool),
+    )
+    stats_b = (
+        edsb[0], occb8[:num_symbols].T, splitb[0],
+        reachedb[0].astype(bool),
+    )
+    return (
+        out, steps, code, stats_a, stats_b, acta_b, actb_b,
+        consa_row, consb_row, rec_count, rec_steps, rec_f1, rec_f2,
+        rec_a1.astype(bool), rec_a2.astype(bool),
     )
