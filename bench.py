@@ -293,6 +293,7 @@ def bench_single(num_reads, seq_len, error_rate, trace=None):
             "device_dispatches": dispatches,
             "run_extend_calls": counters.get("run_calls", 0),
             "run_extend_steps": counters.get("run_steps", 0),
+            "run_pallas_calls": counters.get("run_pallas_calls", 0),
             "push_calls": counters.get("push_calls", 0),
             "arena_calls": counters.get("arena_calls", 0),
             "arena_steps": counters.get("arena_steps", 0),
