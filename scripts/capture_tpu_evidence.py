@@ -96,25 +96,64 @@ def run_grid(timeout_s):
     return len(done) >= 12
 
 
+def run_dual_priority(timeout_s):
+    """TPU-wall versions of the dual/priority evidence workloads (the
+    r5 dispatch counts were recorded on jax-CPU; the device wall makes
+    the dispatches x ~80 ms model concrete)."""
+    out = os.path.join(EVID, "DUAL_PRIORITY_r05_tpu.jsonl")
+    try:
+        p = subprocess.run(
+            [sys.executable, "scripts/dispatch_evidence.py", "--dual",
+             "16", "1500", "--priority", "32", "2000", "--platform",
+             "device"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    lines = [
+        ln for ln in (p.stdout or "").splitlines()
+        if ln.startswith("{")
+    ]
+    if not lines:
+        return False
+    with open(out, "a") as f:
+        for ln in lines:
+            f.write(ln + "\n")
+    print(f"dual/priority: {len(lines)} lines", flush=True)
+    return True
+
+
 def main():
     deadline = time.time() + 60 * (
         int(sys.argv[1]) if len(sys.argv) > 1 else 360
     )
     selfrun_done = False
+    selfrun_tries = 0
     grid_done = False
-    while time.time() < deadline and not (selfrun_done and grid_done):
+    dp_done = False
+    while time.time() < deadline and not (
+        selfrun_done and grid_done and dp_done
+    ):
         if not probe():
             print("tunnel down; sleeping 120s", flush=True)
             time.sleep(120)
             continue
         print("tunnel UP", flush=True)
-        if not selfrun_done:
+        if not selfrun_done and selfrun_tries < 6:
+            selfrun_tries += 1
             selfrun_done = run_selfrun()
             continue  # re-probe between stages
         if not grid_done:
             grid_done = run_grid(min(3600, deadline - time.time()))
+            continue
+        if not dp_done:
+            dp_done = run_dual_priority(
+                min(1800, deadline - time.time())
+            )
+            if not dp_done:
+                time.sleep(60)
     print("watchdog exit: selfrun", selfrun_done, "grid", grid_done,
-          flush=True)
+          "dual/priority", dp_done, flush=True)
 
 
 if __name__ == "__main__":

@@ -18,11 +18,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")  # reliable CPU pin (see bench.py)
+if "--platform" not in " ".join(sys.argv) or "cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")  # reliable CPU pin (see bench.py)
 
 import numpy as np
 
 from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS as DISPATCH_KEYS
+
+
+def _plat():
+    return "jax" + jax.devices()[0].platform
 
 
 def _cfg(backend, min_count, band):
@@ -76,9 +81,9 @@ def run_dual(num_reads, seq_len):
     wall = time.perf_counter() - t0
     c = eng.last_search_stats["scorer_counters"]
     return {
-        "metric": f"dual_{num_reads}x{seq_len}_jaxcpu",
+        "metric": f"dual_{num_reads}x{seq_len}_{_plat()}",
         "parity": bool(res == cpp),
-        "jax_cpu_wall_s": round(wall, 3),
+        "jax_wall_s": round(wall, 3),
         "cpp_wall_s": round(cpp_wall, 4),
         "blocking_dispatches": sum(c.get(k, 0) for k in DISPATCH_KEYS),
         "counters": {
@@ -124,9 +129,9 @@ def run_priority(num_reads, seq_len):
     wall = time.perf_counter() - t0
     c = eng.last_search_stats["scorer_counters"]
     return {
-        "metric": f"priority_{num_reads}x{seq_len}_jaxcpu",
+        "metric": f"priority_{num_reads}x{seq_len}_{_plat()}",
         "parity": bool(res == cpp),
-        "jax_cpu_wall_s": round(wall, 3),
+        "jax_wall_s": round(wall, 3),
         "cpp_wall_s": round(cpp_wall, 4),
         "blocking_dispatches": sum(c.get(k, 0) for k in DISPATCH_KEYS),
         "counters": {
@@ -141,6 +146,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--dual", nargs=2, type=int, default=None)
     parser.add_argument("--priority", nargs=2, type=int, default=None)
+    parser.add_argument("--platform", default="cpu", choices=["cpu", "device"])
     args = parser.parse_args()
 
     from waffle_con_tpu.utils.cache import enable_compilation_cache
