@@ -18,12 +18,19 @@ read window is an aligned dynamic sublane load + ``pltpu.roll`` by the
 The in-column insertion chain (``lax.cummin`` upstream) is an exact
 log-shift prefix-min over sublanes.
 
-Semantics mirror ``_j_run`` decision-for-decision (stop codes, vote
-EPS contract, record absorption, forced first symbol, band-overflow
-refusal); see that docstring for the contract and
-`/root/reference/src/consensus.rs` for the host search it accelerates.
-Parity is enforced by tests/test_pallas_run.py (interpret mode on CPU)
-and the fuzz/e2e suites with ``WAFFLE_PALLAS=interpret``.
+Semantics mirror ``_j_run`` / ``_j_run_dual`` decision-for-decision
+(stop codes, vote EPS contract, record absorption, forced first
+symbol, band-overflow refusal, locks, divergence pruning, min-count
+tables); see those docstrings for the contracts.  The host searches
+these kernels accelerate are the reference's symbol-at-a-time loops:
+``/root/reference/src/consensus.rs:258-472`` (advance/expand),
+``/root/reference/src/dual_consensus.rs:606-734`` (dual extension
+cross product) and ``:1257-1336`` (vote weights), with the per-symbol
+wavefront hot loop at ``/root/reference/src/dynamic_wfa.rs:75-191``
+re-derived as the banded column DP (equivalence argument in
+ops/jax_scorer.py).  Parity is enforced by tests/test_pallas_run.py
+(interpret mode on CPU) and the fuzz/e2e suites with
+``WAFFLE_PALLAS=interpret``.
 """
 
 from __future__ import annotations
