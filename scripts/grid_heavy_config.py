@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""One-off: measure the tie-heaviest criterion-grid config
+(consensus_4x10000x8_0.02 — never completed in any round's budget) on
+the jax-CPU backend with a multi-hour cap, appending the line to
+evidence/GRID_r05_jaxcpu.jsonl."""
+import json
+import subprocess
+import sys
+import time
+
+CHILD = '''
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "/root/repo")
+from waffle_con_tpu.utils.cache import enable_compilation_cache
+enable_compilation_cache()
+import bench
+out = bench.bench_single(8, 10000, 0.02)
+out["metric"] = "consensus_4x10000x8_0.02"
+out["device_platform"] = "cpu"
+print("GRIDLINE " + json.dumps(out))
+'''
+
+
+def main():
+    t0 = time.time()
+    p = subprocess.run(
+        [sys.executable, "-c", CHILD], capture_output=True, text=True,
+        timeout=28000,
+    )
+    for ln in (p.stdout or "").splitlines():
+        if ln.startswith("GRIDLINE "):
+            d = json.loads(ln[9:])
+            d["runner_wall_s"] = round(time.time() - t0, 1)
+            with open(
+                "/root/repo/evidence/GRID_r05_jaxcpu.jsonl", "a"
+            ) as f:
+                f.write(json.dumps(d) + "\n")
+            print("captured", d["metric"], d.get("value"), flush=True)
+            return
+    print("no line; rc", p.returncode, (p.stderr or "")[-300:], flush=True)
+
+
+if __name__ == "__main__":
+    main()
