@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""A/B the run-kernel implementations on the live device with one
+command: XLA while-loop vs fused pallas (int32 tile) vs fused pallas
+(int16 tile), each in its own subprocess (the pallas mode is resolved
+once per process).
+
+Usage: python scripts/ubench_ab.py [steps] [band]
+Writes one summary line per variant; ~3 x (compile + run) total.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STEPS = sys.argv[1] if len(sys.argv) > 1 else "4000"
+BAND = sys.argv[2] if len(sys.argv) > 2 else "216"
+
+VARIANTS = [
+    ("xla", {"WAFFLE_PALLAS": "0"}),
+    ("pallas-i32", {"WAFFLE_PALLAS": "auto", "WAFFLE_PALLAS_I16": "0"}),
+    ("pallas-i16", {"WAFFLE_PALLAS": "auto", "WAFFLE_PALLAS_I16": "1"}),
+]
+
+for name, env in VARIANTS:
+    e = dict(os.environ, **env)
+    try:
+        p = subprocess.run(
+            [sys.executable, "scripts/ubench_jrun.py", STEPS, BAND],
+            capture_output=True, text=True, timeout=900, cwd=ROOT, env=e,
+        )
+        runs = [
+            ln for ln in (p.stdout or "").splitlines()
+            if ln.startswith("run ")
+        ]
+        best = None
+        for ln in runs:
+            us = float(ln.split()[-2])
+            best = us if best is None else min(best, us)
+        print(json.dumps({
+            "variant": name,
+            "best_us_per_step": best,
+            "runs": runs,
+            "rc": p.returncode,
+            "err": (p.stderr or "")[-200:] if p.returncode else "",
+        }), flush=True)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"variant": name, "error": "timeout"}),
+              flush=True)
