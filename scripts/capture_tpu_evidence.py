@@ -139,6 +139,11 @@ def main():
             time.sleep(120)
             continue
         print("tunnel UP", flush=True)
+        # the box has ONE cpu core: any background measurement would
+        # contend with the bench children and distort both the C++
+        # baseline and the host-side timings — clear the deck first
+        subprocess.run(["pkill", "-f", "grid_heavy_config"],
+                       capture_output=True)
         if not selfrun_done and selfrun_tries < 6:
             selfrun_tries += 1
             selfrun_done = run_selfrun()
