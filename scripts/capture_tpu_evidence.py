@@ -144,6 +144,8 @@ def main():
         # baseline and the host-side timings — clear the deck first
         subprocess.run(["pkill", "-f", "grid_heavy_config"],
                        capture_output=True)
+        subprocess.run(["pkill", "-f", "test_slow_scale"],
+                       capture_output=True)
         if not selfrun_done and selfrun_tries < 6:
             selfrun_tries += 1
             selfrun_done = run_selfrun()
