@@ -334,14 +334,18 @@ def _j_mkpad(reads, W: int):
 _PALLAS_MS_CAP = 32768
 
 
-@partial(jax.jit, static_argnames=("rows",))
-def _j_mkpad_T(reads_pad, rows: int):
-    """Transposed ``[rows, R]`` staging of the padded reads for the
-    fused pallas kernel (band position on sublanes — Mosaic only allows
-    dynamic slicing there), row-padded for the aligned window loads."""
-    R, Lp = reads_pad.shape
-    out = jnp.full((rows, R), -1, reads_pad.dtype)
-    return lax.dynamic_update_slice(out, reads_pad.T, (0, 0))
+@partial(jax.jit, static_argnames=("W", "rows"))
+def _j_mkpad_T(reads, W: int, rows: int):
+    """Transposed ``[rows, R]`` staging of the reads for the fused
+    pallas kernel (band position on sublanes — Mosaic only allows
+    dynamic slicing there): ``W`` rows of ``-1`` filler, then the read
+    symbols, then filler to ``rows`` (see ``pallas_run.staging_rows``
+    for the sizing argument — the pow2-padded storage tail is NOT
+    materialized)."""
+    R, L = reads.shape
+    n = min(L, max(rows - W, 0))
+    out = jnp.full((rows, R), -1, reads.dtype)
+    return lax.dynamic_update_slice(out, reads.T[:n], (W, 0))
 
 
 @partial(jax.jit, static_argnames=("new_b",))
@@ -2286,6 +2290,8 @@ class JaxScorer(WavefrontScorer):
             self._R = ms * ((self._R + ms - 1) // ms)
         self._shardings = None  # installed by parallel.shard_scorer
         max_len = max((len(r) for r in self.reads), default=1)
+        #: real (unpadded) max read length; sizes the pallas staging
+        self._max_rlen = max_len
         self._L = max(_next_pow2(max(max_len, 1)), self.MIN_L)
         self._A = max(_next_pow2(max(self.num_symbols, 1)), self.MIN_A)
 
@@ -2712,13 +2718,13 @@ class JaxScorer(WavefrontScorer):
     def _reads_T_rows(self) -> int:
         from waffle_con_tpu.ops.pallas_run import staging_rows
 
-        return staging_rows(self._reads_pad.shape[1], self._W)
+        return staging_rows(self._max_rlen, self._W)
 
     def _reads_T(self):
         """Lazily staged transposed reads for the pallas kernel."""
         if self._reads_T_cache is None:
             self._reads_T_cache = _j_mkpad_T(
-                self._reads_pad, rows=self._reads_T_rows()
+                self._reads, W=self._W, rows=self._reads_T_rows()
             )
         return self._reads_T_cache
 

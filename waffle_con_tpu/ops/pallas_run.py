@@ -52,8 +52,10 @@ from waffle_con_tpu.ops.jax_scorer import INF, REC_CAP, VOTE_EPS
 _ALIGN = 16
 
 #: VMEM budget gate for the whole-array-resident kernel; above this the
-#: caller falls back to the XLA while-loop path
-_VMEM_BUDGET = 10 * 1024 * 1024
+#: caller falls back to the XLA while-loop path.  ~16 MB of VMEM per
+#: core minus headroom for Mosaic's own carry double-buffering; the
+#: estimate in fits_budget is itself conservative (int32 tile sizes)
+_VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def pallas_mode() -> str:
@@ -73,12 +75,14 @@ def pallas_mode() -> str:
     return "interpret" if env == "1" else "off"
 
 
-def fits_budget(L_pad: int, R: int, W: int, C: int,
+def fits_budget(stage_rows: int, R: int, W: int, C: int,
                 sides: int = 1) -> bool:
-    """Conservative VMEM estimate for the resident kernel;
+    """Conservative VMEM estimate for the resident kernel.
+    ``stage_rows`` is the transposed-staging row count (from
+    :func:`staging_rows` — NOT the pow2-padded storage length);
     ``sides=2`` models the dual kernel (two DP tiles in+out, two stats
     blocks, and four REC_CAP x R record planes instead of one)."""
-    reads = L_pad * R * 2
+    reads = stage_rows * R * 2
     tiles = sides * 6 * W * R * 4  # D + dele/base/chain temporaries
     rec = (4 if sides == 2 else 1) * REC_CAP * R * 4
     return reads + tiles + rec + C * 4 < _VMEM_BUDGET
@@ -90,10 +94,17 @@ def window_block(W: int) -> int:
     return ((W + 2 * _ALIGN - 1) // _ALIGN) * _ALIGN
 
 
-def staging_rows(Lp: int, W: int) -> int:
-    """Row count of the transposed reads staging: ``Lp + window_block``
-    rows guarantee every clipped window load lands in ``-1`` filler."""
-    return ((Lp + window_block(W) + _ALIGN - 1) // _ALIGN) * _ALIGN
+def staging_rows(max_rlen: int, W: int) -> int:
+    """Row count of the transposed reads staging, sized by the REAL
+    max read length (not the pow2-padded storage axis — for 10 kb reads
+    that padding alone would blow the VMEM budget): rows cover
+    ``W`` filler + every real read position + one aligned window block,
+    so any clipped/overrun window load lands in ``-1`` filler or at
+    positions past every read's end (masked by ``i < rlen`` /
+    ``i_new > rlen`` either way).  Bucketed to 1 KiB rows to bound the
+    number of compiled kernel geometries."""
+    need = W + max_rlen + window_block(W) + _ALIGN
+    return ((need + 1023) // 1024) * 1024
 
 
 #: int16 band "infinity": every legitimate finite cell value is gated
