@@ -92,6 +92,32 @@ def test_pallas_int32_tile_parity(monkeypatch):
     assert _dual_once("off", **dual) == _dual_once("interpret", **dual)
 
 
+def test_pallas_engages_at_north_star_geometry():
+    """The VMEM gate must admit the north-star shapes (10 kb reads,
+    R=256, E=256): an earlier revision sized the staging from the
+    pow2-padded storage axis and silently rejected the fused kernel at
+    exactly the scale it was built for."""
+    from waffle_con_tpu.ops.pallas_run import (
+        fits_budget, i16_ok, staging_rows,
+    )
+
+    W = 2 * 256 + 2
+    rows = staging_rows(10_050, W)
+    assert fits_budget(rows, 256, W, 16_384, sides=1)
+    assert i16_ok(16_384, 16_384, W)
+    # and a real scorer at a long-read geometry reports eligibility
+    rng = np.random.default_rng(5)
+    reads = [bytes(rng.integers(0, 4, size=10_050).astype(np.uint8))
+             for _ in range(4)]
+    sc = JaxScorer(
+        reads,
+        CdwfaConfigBuilder().min_count(2).backend("jax")
+        .initial_band(216).build(),
+    )
+    sc._pallas_mode = "interpret"
+    assert sc._pallas_ok(sides=1)
+
+
 def test_pallas_run_record_absorption():
     """Early-reached reads: the kernel buffers records exactly like the
     XLA path (same (step, fin) pairs, same budget shrinking)."""
