@@ -2662,9 +2662,10 @@ class JaxScorer(WavefrontScorer):
     def _pallas_ok(self, sides: int = 1) -> bool:
         """Fused-kernel eligibility: mode on (and that kernel not
         individually disabled by an earlier compile failure) + the
-        whole staging fits the VMEM budget at current geometry + the
-        occ output rows cover the alphabet (the kernel emits a fixed
-        8-row occ block) + the scorer is unsharded (pallas_call cannot
+        whole staging fits the VMEM budget at current geometry (with
+        the tile dtype the dispatch would actually use) + the occ
+        output rows cover the alphabet (the kernel emits a fixed 8-row
+        occ block) + the scorer is unsharded (pallas_call cannot
         partition GSPMD-sharded operands; the mesh path keeps the XLA
         while-loop kernels)."""
         if self._pallas_mode == "off" or self._A > 8:
@@ -2676,7 +2677,16 @@ class JaxScorer(WavefrontScorer):
         from waffle_con_tpu.ops.pallas_run import fits_budget
 
         return fits_budget(
-            self._reads_T_rows(), self._R, self._W, self._C, sides
+            self._reads_T_rows(), self._R, self._W, self._C, sides,
+            self._pallas_i16(),
+        )
+
+    def _pallas_i16(self) -> bool:
+        from waffle_con_tpu.ops.pallas_run import i16_ok
+
+        return (
+            i16_ok(self._L, self._C, self._W)
+            and os.environ.get("WAFFLE_PALLAS_I16", "1") != "0"
         )
 
     def _pallas_prep(self, longest: int, max_steps: int):
@@ -2685,16 +2695,10 @@ class JaxScorer(WavefrontScorer):
         4 and the engine re-engages), grow the consensus axis to fit,
         and resolve the DP-tile dtype.  Returns (MS, capped_steps,
         i16)."""
-        from waffle_con_tpu.ops.pallas_run import i16_ok
-
         MS = _next_pow2(min(max_steps, _PALLAS_MS_CAP - 2) + 2, 256)
         while longest + MS + 2 >= self._C:
             self._grow_cons()
-        i16 = (
-            i16_ok(self._L, self._C, self._W)
-            and os.environ.get("WAFFLE_PALLAS_I16", "1") != "0"
-        )
-        return MS, min(max_steps, MS - 2), i16
+        return MS, min(max_steps, MS - 2), self._pallas_i16()
 
     def _pallas_guarded(self, sides: int, fn, *args):
         """Run a fused-kernel wrapper, bumping its engagement counter;

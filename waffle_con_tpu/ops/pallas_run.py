@@ -76,14 +76,17 @@ def pallas_mode() -> str:
 
 
 def fits_budget(stage_rows: int, R: int, W: int, C: int,
-                sides: int = 1) -> bool:
+                sides: int = 1, i16: bool = False) -> bool:
     """Conservative VMEM estimate for the resident kernel.
     ``stage_rows`` is the transposed-staging row count (from
     :func:`staging_rows` — NOT the pow2-padded storage length);
     ``sides=2`` models the dual kernel (two DP tiles in+out, two stats
-    blocks, and four REC_CAP x R record planes instead of one)."""
+    blocks, and four REC_CAP x R record planes instead of one);
+    ``i16`` halves the DP-tile term (the int16 tile is what admits
+    10 kb-scale dual geometries)."""
     reads = stage_rows * R * 2
-    tiles = sides * 6 * W * R * 4  # D + dele/base/chain temporaries
+    cell = 2 if i16 else 4
+    tiles = sides * 6 * W * R * cell  # D + dele/base/chain temporaries
     rec = (4 if sides == 2 else 1) * REC_CAP * R * 4
     return reads + tiles + rec + C * 4 < _VMEM_BUDGET
 
