@@ -118,6 +118,37 @@ def test_pallas_engages_at_north_star_geometry():
     assert sc._pallas_ok(sides=1)
 
 
+def test_pallas_band_growth_parity():
+    """A deliberately tiny initial band forces code-5 stops + band
+    growth mid-search; the pallas path must re-stage (new W geometry)
+    and still match the oracle byte-for-byte."""
+    from waffle_con_tpu.models.consensus import ConsensusDWFA
+    from waffle_con_tpu.native import native_consensus
+
+    truth, reads = generate_test(4, 180, 8, 0.04, seed=91)
+    mk = lambda be: (  # noqa: E731
+        CdwfaConfigBuilder().min_count(2).backend(be).initial_band(2)
+        .build()
+    )
+    want = native_consensus(reads, config=mk("native"))
+
+    import waffle_con_tpu.ops.pallas_run as pr
+
+    old = pr.pallas_mode
+    pr.pallas_mode = lambda: "interpret"
+    try:
+        eng = ConsensusDWFA(mk("jax"))
+        for r in reads:
+            eng.add_sequence(r)
+        got = [(c.sequence, c.scores) for c in eng.consensus()]
+        counters = eng.last_search_stats["scorer_counters"]
+    finally:
+        pr.pallas_mode = old
+    assert got == want
+    assert counters.get("grow_e_events", 0) >= 1
+    assert counters.get("run_pallas_calls", 0) >= 1
+
+
 def test_pallas_run_record_absorption():
     """Early-reached reads: the kernel buffers records exactly like the
     XLA path (same (step, fin) pairs, same budget shrinking)."""
