@@ -324,8 +324,11 @@ def test_pallas_priority_engine_parity():
         for ch in chains:
             eng.add_sequence_chain(ch)
         got = eng.consensus()
+        counters = eng.last_search_stats["scorer_counters"]
     finally:
         pr.pallas_mode = old
+    # the chains' uniform nonzero-offset runs must take the fused path
+    assert counters.get("run_pallas_calls", 0) >= 1
     flat = lambda p: [  # noqa: E731
         [(c.sequence, c.scores) for c in chain] for chain in p.consensuses
     ]
