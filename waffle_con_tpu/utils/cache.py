@@ -13,11 +13,15 @@ import platform
 
 
 def _host_fingerprint() -> str:
-    """A digest of the host CPU's feature set.  XLA:CPU caches AOT
-    machine code for the COMPILING host; loading it on a host missing
-    any of those features can SIGILL (observed live: a cache populated
-    on an AVX512-full machine crashed the test suite on a smaller one).
-    Scoping the cache directory by this fingerprint makes cross-host
+    """A digest of everything that shapes an XLA:CPU AOT executable's
+    machine-code compatibility.  Loading an entry produced under a
+    different configuration can SIGILL/segfault inside the cache
+    loader (observed live twice: a cache populated on an AVX512-full
+    machine crashed a smaller host, and entries written by
+    TPU-attached processes — whose terminal-injected ``XLA_FLAGS``
+    change the CPU codegen tuning, e.g. ``prefer-no-scatter`` — later
+    crashed pure-CPU runs on the SAME host).  Scoping the directory by
+    CPU flags + jax/jaxlib version + ambient XLA env makes that
     pollution structurally impossible."""
     try:
         with open("/proc/cpuinfo") as f:
@@ -29,6 +33,19 @@ def _host_fingerprint() -> str:
                 feats = platform.processor()
     except OSError:  # pragma: no cover - non-Linux fallback
         feats = platform.processor()
+    import jax
+
+    feats += "|" + jax.__version__
+    feats += "|" + os.environ.get("XLA_FLAGS", "")
+    feats += "|" + os.environ.get("LIBTPU_INIT_ARGS", "")
+    # TPU-attached processes compile their host-side CPU executables
+    # under terminal-injected codegen flags that leave no trace in this
+    # process's env; the resolved platform selection is the reliable
+    # discriminator (reading the config does NOT initialize a backend)
+    feats += "|" + str(
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS", "")
+    )
     return hashlib.sha256(feats.encode()).hexdigest()[:12]
 
 
