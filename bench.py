@@ -72,6 +72,21 @@ def _remaining() -> float:
     return max(0.0, TOTAL_BUDGET_S - (time.monotonic() - _START))
 
 
+def _time_stats(times):
+    """``(min, median)`` of a non-empty list of wall times."""
+    ts = sorted(times)
+    n = len(ts)
+    mid = n // 2
+    median = ts[mid] if n % 2 else (ts[mid - 1] + ts[mid]) / 2
+    return ts[0], median
+
+
+def _runtime_events() -> dict:
+    from waffle_con_tpu.runtime import events
+
+    return events.summarize_events()
+
+
 def _force_cpu_backend() -> None:
     """Pin JAX to the host CPU backend.  The ambient env pins
     ``JAX_PLATFORMS`` to the TPU plugin and a sitecustomize re-registers
@@ -219,7 +234,7 @@ def _band_seed(seq_len, error_rate) -> int:
     return BAND_MARGIN + int(2 * error_rate * seq_len)
 
 
-def bench_single(num_reads, seq_len, error_rate, trace=None):
+def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5):
     from waffle_con_tpu import CdwfaConfigBuilder
     from waffle_con_tpu.native import native_consensus
     from waffle_con_tpu.utils.example_gen import generate_test
@@ -256,9 +271,12 @@ def bench_single(num_reads, seq_len, error_rate, trace=None):
         import jax
 
         jax.profiler.start_trace(trace)
-    tpu_start = time.perf_counter()
-    engine, tpu_results = tpu_run()
-    tpu_time = time.perf_counter() - tpu_start
+    times = []
+    for _ in range(max(1, iters)):
+        tpu_start = time.perf_counter()
+        engine, tpu_results = tpu_run()
+        times.append(time.perf_counter() - tpu_start)
+    tpu_min, tpu_time = _time_stats(times)
     if trace:
         import jax
 
@@ -277,6 +295,9 @@ def bench_single(num_reads, seq_len, error_rate, trace=None):
     return {
         "metric": f"consensus_{num_reads}x{seq_len}_wall_s",
         "value": round(tpu_time, 4),
+        "value_min": round(tpu_min, 4),
+        "value_median": round(tpu_time, 4),
+        "n_iters": len(times),
         "unit": "s",
         "vs_baseline": round(cpu_time / tpu_time, 3),
         "cpu_baseline_s": round(cpu_time, 4),
@@ -305,11 +326,12 @@ def bench_single(num_reads, seq_len, error_rate, trace=None):
                 (counters.get("run_steps", 0) + counters.get("push_calls", 0))
                 / max(tpu_time, 1e-9)
             ),
+            "runtime_events": _runtime_events(),
         },
     }
 
 
-def bench_dual(num_reads, seq_len, error_rate):
+def bench_dual(num_reads, seq_len, error_rate, iters=5):
     """Dual north-star: two haplotypes differing by 3 SNPs, half the reads
     each; CPU baseline is the complete C++ dual engine."""
     from waffle_con_tpu import CdwfaConfigBuilder
@@ -350,9 +372,12 @@ def bench_dual(num_reads, seq_len, error_rate):
         return engine, engine.consensus()
 
     engine, tpu_results = tpu_run()
-    tpu_start = time.perf_counter()
-    engine, tpu_results = tpu_run()
-    tpu_time = time.perf_counter() - tpu_start
+    times = []
+    for _ in range(max(1, iters)):
+        tpu_start = time.perf_counter()
+        engine, tpu_results = tpu_run()
+        times.append(time.perf_counter() - tpu_start)
+    tpu_min, tpu_time = _time_stats(times)
 
     stats = getattr(engine, "last_search_stats", {})
     counters = stats.get("scorer_counters", {})
@@ -367,6 +392,9 @@ def bench_dual(num_reads, seq_len, error_rate):
     return {
         "metric": f"dual_{num_reads}x{seq_len}_wall_s",
         "value": round(tpu_time, 4),
+        "value_min": round(tpu_min, 4),
+        "value_median": round(tpu_time, 4),
+        "n_iters": len(times),
         "unit": "s",
         "vs_baseline": round(cpu_time / tpu_time, 3),
         "cpu_baseline_s": round(cpu_time, 4),
@@ -396,11 +424,12 @@ def bench_dual(num_reads, seq_len, error_rate):
                 / total_symbols,
                 3,
             ),
+            "runtime_events": _runtime_events(),
         },
     }
 
 
-def bench_priority(num_reads, seq_len, error_rate):
+def bench_priority(num_reads, seq_len, error_rate, iters=5):
     """Priority north-star: 2-level chains splitting into two groups."""
     from waffle_con_tpu import CdwfaConfigBuilder
     from waffle_con_tpu.native import native_priority_consensus
@@ -437,18 +466,25 @@ def bench_priority(num_reads, seq_len, error_rate):
         return _make_engine("priority", cfg("jax"), chains).consensus()
 
     tpu_result = tpu_run()
-    tpu_start = time.perf_counter()
-    tpu_result = tpu_run()
-    tpu_time = time.perf_counter() - tpu_start
+    times = []
+    for _ in range(max(1, iters)):
+        tpu_start = time.perf_counter()
+        tpu_result = tpu_run()
+        times.append(time.perf_counter() - tpu_start)
+    tpu_min, tpu_time = _time_stats(times)
 
     return {
         "metric": f"priority_{num_reads}x{seq_len}_wall_s",
         "value": round(tpu_time, 4),
+        "value_min": round(tpu_min, 4),
+        "value_median": round(tpu_time, 4),
+        "n_iters": len(times),
         "unit": "s",
         "vs_baseline": round(cpu_time / tpu_time, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(tpu_result == cpu_result),
         "groups": len(tpu_result.consensuses),
+        "runtime_events": _runtime_events(),
     }
 
 
@@ -574,7 +610,8 @@ def _north_star_orchestrated(args) -> None:
     def attempt(i, num_reads, seq_len, platform):
         cap = RUNG_CAPS_S[i] if i < len(RUNG_CAPS_S) else _remaining()
         timeout_s = min(cap, max(0, _remaining() - GATE_RESERVE_S))
-        mode = ["--_run", "--reads", str(num_reads), "--len", str(seq_len)]
+        mode = ["--_run", "--reads", str(num_reads), "--len", str(seq_len),
+                "--iters", str(args.iters)]
         if args.trace:
             mode += ["--trace", args.trace]
         label = f"attempt {num_reads}x{seq_len}@{platform}"
@@ -662,12 +699,12 @@ def _north_star_orchestrated(args) -> None:
         ["--dual"]
         if gate_platform == "device"
         else ["--dual", "--reads", "16", "--len", "1500"]
-    )
+    ) + ["--iters", str(args.iters)]
     priority_scale = (
         ["--priority"]
         if gate_platform == "device"
         else ["--priority", "--reads", "16", "--len", "1000"]
-    )
+    ) + ["--iters", str(args.iters)]
     for mode, label, budget_need in (
         (dual_scale, "dual", 300),
         (priority_scale, "priority", 240),
@@ -691,6 +728,10 @@ def main() -> None:
     parser.add_argument("--dual", action="store_true")
     parser.add_argument("--priority", action="store_true")
     parser.add_argument("--smoke", action="store_true")
+    parser.add_argument(
+        "--iters", type=int, default=5,
+        help="timed iterations per bench point (min/median reported)",
+    )
     parser.add_argument("--trace", default=None)
     parser.add_argument(
         "--platform", choices=("auto", "cpu", "device"), default="auto"
@@ -717,7 +758,7 @@ def main() -> None:
             enable_compilation_cache()
             out = bench_single(
                 args.reads or 256, args.seq_len or 10_000, 0.01,
-                trace=args.trace,
+                trace=args.trace, iters=args.iters,
             )
             out["device_platform"] = _current_platform()
             print(json.dumps(out))
@@ -752,7 +793,9 @@ def main() -> None:
         for seq_len in (1000, 10_000):
             for num_samples in (8, 30):
                 for error_rate in (0.0, 0.01, 0.02):
-                    out = bench_single(num_samples, seq_len, error_rate)
+                    out = bench_single(
+                        num_samples, seq_len, error_rate, iters=args.iters
+                    )
                     out["metric"] = (
                         f"consensus_4x{seq_len}x{num_samples}_{error_rate}"
                     )
@@ -763,7 +806,9 @@ def main() -> None:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
 
         enable_compilation_cache()
-        out = bench_dual(args.reads or 64, args.seq_len or 5000, 0.01)
+        out = bench_dual(
+            args.reads or 64, args.seq_len or 5000, 0.01, iters=args.iters
+        )
         out["device_platform"] = _current_platform()
         print(json.dumps(out))
         return
@@ -771,7 +816,9 @@ def main() -> None:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
 
         enable_compilation_cache()
-        out = bench_priority(args.reads or 32, args.seq_len or 2000, 0.01)
+        out = bench_priority(
+            args.reads or 32, args.seq_len or 2000, 0.01, iters=args.iters
+        )
         out["device_platform"] = _current_platform()
         print(json.dumps(out))
         return

@@ -26,6 +26,24 @@ from waffle_con_tpu.utils.cache import enable_compilation_cache  # noqa: E402
 enable_compilation_cache()
 
 
+@pytest.fixture
+def faults():
+    """A fresh, installed :class:`FaultPlan`; the test adds rules via
+    ``faults.add(...)``.  Teardown clears the plan AND the runtime event
+    log so fault tests never leak injected state into later tests."""
+    from waffle_con_tpu.runtime import events
+    from waffle_con_tpu.runtime import faults as faults_mod
+
+    plan = faults_mod.FaultPlan()
+    faults_mod.install(plan)
+    events.clear_events()
+    try:
+        yield plan
+    finally:
+        faults_mod.clear()
+        events.clear_events()
+
+
 def pytest_collection_modifyitems(config, items):
     """Deselect ``slow``-marked tests unless RUN_SLOW=1 is set or the user
     selected them explicitly with ``-m``."""

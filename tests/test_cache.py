@@ -1,0 +1,147 @@
+"""Compilation-cache integrity: fingerprint scoping + quarantine.
+
+The cache dir is scoped by a host fingerprint precisely because loading
+an entry compiled under a different jax version / XLA flag set /
+platform selection can segfault inside the cache loader (utils/cache.py
+docstring records two live incidents).  These tests pin the scoping and
+the hash-verify/quarantine machinery without ever letting JAX load a
+corrupt entry.
+"""
+
+import json
+import logging
+import os
+
+import jax
+import pytest
+
+from waffle_con_tpu.utils.cache import (
+    MANIFEST_NAME,
+    QUARANTINE_DIR,
+    _host_fingerprint,
+    enable_compilation_cache,
+    quarantine_corrupt_entries,
+)
+
+
+@pytest.fixture
+def restore_cache_dir():
+    """Tests below repoint the live jax compilation-cache config at tmp
+    dirs; put it back so later tests keep the real persistent cache."""
+    before = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", before)
+
+
+# ---------------------------------------------------------------- scoping
+
+
+def test_fingerprint_changes_with_xla_flags(monkeypatch):
+    base = _host_fingerprint()
+    monkeypatch.setenv("XLA_FLAGS", "--xla_cpu_prefer_no_scatter=true")
+    assert _host_fingerprint() != base
+
+
+def test_fingerprint_changes_with_jax_version(monkeypatch):
+    base = _host_fingerprint()
+    monkeypatch.setattr(jax, "__version__", "0.0.0-test")
+    assert _host_fingerprint() != base
+
+
+def test_fingerprint_changes_with_platform_selection(monkeypatch):
+    base = _host_fingerprint()
+    # the conftest pins jax_platforms=cpu; a TPU-attached process resolves
+    # differently and must land in a different directory
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms", property(lambda self: "tpu")
+    )
+    assert _host_fingerprint() != base
+
+
+def test_distinct_fingerprints_mean_distinct_default_dirs(monkeypatch):
+    dir_a = os.path.join("~", f"waffle_con_tpu_jax-{_host_fingerprint()}")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    dir_b = os.path.join("~", f"waffle_con_tpu_jax-{_host_fingerprint()}")
+    assert dir_a != dir_b
+
+
+def test_jax_cache_dir_env_override(tmp_path, restore_cache_dir, monkeypatch):
+    target = str(tmp_path / "override")
+    monkeypatch.setenv("JAX_CACHE_DIR", target)
+    assert enable_compilation_cache() == target
+    assert jax.config.jax_compilation_cache_dir == target
+
+
+# ------------------------------------------------------------- quarantine
+
+
+def _write_entry(path, name, data=b"\x00" * 256):
+    with open(os.path.join(path, name), "wb") as f:
+        f.write(data)
+
+
+def test_new_entries_sealed_into_manifest(tmp_path):
+    path = str(tmp_path)
+    _write_entry(path, "entry_a")
+    assert quarantine_corrupt_entries(path) == []
+    manifest = json.load(open(os.path.join(path, MANIFEST_NAME)))
+    assert "entry_a" in manifest
+
+
+def test_corrupt_entry_quarantined_not_loaded(tmp_path, caplog):
+    path = str(tmp_path)
+    _write_entry(path, "entry_a")
+    quarantine_corrupt_entries(path)  # seal
+    _write_entry(path, "entry_a", b"\xff" * 256)  # corrupt in place
+    with caplog.at_level(logging.WARNING, logger="waffle_con_tpu"):
+        assert quarantine_corrupt_entries(path) == ["entry_a"]
+    # gone from the scan dir (JAX can no longer load it), parked in
+    # quarantine, dropped from the manifest
+    assert not os.path.exists(os.path.join(path, "entry_a"))
+    assert os.path.exists(os.path.join(path, QUARANTINE_DIR, "entry_a"))
+    manifest = json.load(open(os.path.join(path, MANIFEST_NAME)))
+    assert "entry_a" not in manifest
+    assert any("quarantined corrupt" in r.getMessage() for r in caplog.records)
+
+
+def test_intact_entries_survive_quarantine_pass(tmp_path):
+    path = str(tmp_path)
+    _write_entry(path, "entry_a")
+    _write_entry(path, "entry_b", b"\x01" * 64)
+    quarantine_corrupt_entries(path)
+    _write_entry(path, "entry_a", b"\xff")  # corrupt only one
+    assert quarantine_corrupt_entries(path) == ["entry_a"]
+    assert os.path.exists(os.path.join(path, "entry_b"))
+
+
+def test_corrupt_manifest_rebuilt(tmp_path, caplog):
+    path = str(tmp_path)
+    _write_entry(path, "entry_a")
+    with open(os.path.join(path, MANIFEST_NAME), "w") as f:
+        f.write("{not json")
+    with caplog.at_level(logging.WARNING, logger="waffle_con_tpu"):
+        assert quarantine_corrupt_entries(path) == []
+    manifest = json.load(open(os.path.join(path, MANIFEST_NAME)))
+    assert "entry_a" in manifest
+    assert any("corrupt cache manifest" in r.getMessage() for r in caplog.records)
+
+
+def test_vanished_entries_dropped_from_manifest(tmp_path):
+    path = str(tmp_path)
+    _write_entry(path, "entry_a")
+    quarantine_corrupt_entries(path)
+    os.remove(os.path.join(path, "entry_a"))
+    quarantine_corrupt_entries(path)
+    manifest = json.load(open(os.path.join(path, MANIFEST_NAME)))
+    assert "entry_a" not in manifest
+
+
+def test_enable_runs_quarantine(tmp_path, restore_cache_dir):
+    path = str(tmp_path / "cache")
+    os.makedirs(path)
+    _write_entry(path, "entry_a")
+    quarantine_corrupt_entries(path)
+    _write_entry(path, "entry_a", b"\xff" * 8)
+    assert enable_compilation_cache(path) == path
+    assert os.path.exists(os.path.join(path, QUARANTINE_DIR, "entry_a"))
+    assert jax.config.jax_compilation_cache_dir == path

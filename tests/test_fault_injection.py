@@ -1,0 +1,326 @@
+"""Fault-injection harness: supervised dispatch under every fault class.
+
+Each test arms a deterministic :class:`FaultPlan` (via the ``faults``
+conftest fixture) and runs a real search through the
+:class:`BackendSupervisor`.  The core contract under test: a mid-search
+backend failure demotes the live search down the backend chain and the
+final consensus is **byte-identical** (sequence AND scores) to an
+uninterrupted run — for the single, dual, and priority engines alike.
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+    PriorityConsensusDWFA,
+)
+from waffle_con_tpu.ops.scorer import make_scorer
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.runtime import faults as faults_mod
+from waffle_con_tpu.runtime.supervisor import (
+    BackendFailure,
+    BackendSupervisor,
+    effective_chain,
+)
+from waffle_con_tpu.runtime.watchdog import WatchdogError, dispatch_total
+
+pytestmark = pytest.mark.faultinject
+
+SINGLE_READS = (b"ACGTACGTACGT", b"ACGTACGTACGT", b"ACCTACGTACGT")
+DUAL_READS = (b"ACGTACGT", b"ACGTACGT", b"ACTTACGT", b"ACTTACGT")
+PRIORITY_CHAINS = (
+    [b"ACGT", b"ACGTACGT"],
+    [b"ACGT", b"ACGTACGT"],
+    [b"ACTT", b"ACTTACTT"],
+    [b"ACTT", b"ACTTACTT"],
+)
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().min_count(1).backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _sup_cfg(**kw):
+    kw.setdefault("backend_chain", ("python",))
+    kw.setdefault("dispatch_retries", 1)
+    kw.setdefault("breaker_threshold", 2)
+    kw.setdefault("retry_backoff_s", 0.0)
+    return _cfg(**kw)
+
+
+def _run_single(cfg, reads=SINGLE_READS):
+    engine = ConsensusDWFA(cfg)
+    for r in reads:
+        engine.add_sequence(r)
+    return engine, engine.consensus()
+
+
+def _run_dual(cfg, reads=DUAL_READS):
+    engine = DualConsensusDWFA(cfg)
+    for r in reads:
+        engine.add_sequence(r)
+    return engine, engine.consensus()
+
+
+def _run_priority(cfg, chains=PRIORITY_CHAINS):
+    engine = PriorityConsensusDWFA(cfg)
+    for c in chains:
+        engine.add_sequence_chain(c)
+    return engine, engine.consensus()
+
+
+# ------------------------------------------------------------ chain/plan
+
+
+def test_effective_chain_default():
+    assert effective_chain(_cfg()) == ("jax", "native", "python")
+    assert effective_chain(_cfg(backend="native")) == ("native", "python")
+
+
+def test_effective_chain_explicit_starts_at_backend():
+    cfg = _cfg(backend_chain=("python", "jax"))
+    assert effective_chain(cfg) == ("jax", "python")
+
+
+def test_plan_from_env_parsing():
+    plan = faults_mod.plan_from_env("timeout:jax:*:5:1, device_loss:jax:run")
+    assert len(plan.specs) == 2
+    t, d = plan.specs
+    assert (t.kind, t.backend, t.op, t.at, t.count) == (
+        "timeout", "jax", "*", 5, 1,
+    )
+    # omitted at/count fields mean unlimited (None), not the API default
+    assert (d.kind, d.backend, d.op, d.at, d.count) == (
+        "device_loss", "jax", "run", None, None,
+    )
+
+
+def test_env_plan_resolved_lazily(faults, monkeypatch):
+    monkeypatch.setenv("WAFFLE_FAULTS", "garbage:*:stats")
+    monkeypatch.setattr(faults_mod, "_ACTIVE", None)
+    monkeypatch.setattr(faults_mod, "_ENV_CHECKED", False)
+    plan = faults_mod.active()
+    assert plan is not None and plan.specs[0].kind == "garbage"
+
+
+def test_spec_count_bounds_firings(faults):
+    faults.add("timeout", count=2)
+    assert faults_mod.poll("jax", "push", 0) is not None
+    assert faults_mod.poll("jax", "push", 1) is not None
+    assert faults_mod.poll("jax", "push", 2) is None
+
+
+# -------------------------------------------------- demotion, full parity
+
+
+def test_timeout_demotion_single_byte_identical(faults):
+    _, expected = _run_single(_cfg())
+    # two consecutive injected timeouts at dispatch 3/4 (mid-search for
+    # this workload): the first attempt fails, its retry fails too ->
+    # retries exhausted -> the supervisor demotes jax -> python with the
+    # live handles migrated
+    faults.add("timeout", backend="jax", at=3, count=None)
+    faults.add("timeout", backend="jax", at=4, count=None)
+    _, got = _run_single(_sup_cfg())
+    demotions = events.get_events("backend_demoted")
+    assert [(d["from_backend"], d["to_backend"]) for d in demotions] == [
+        ("jax", "python")
+    ]
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+def test_timeout_demotion_dual_byte_identical(faults):
+    _, expected = _run_dual(_cfg())
+    faults.add("timeout", backend="jax", at=3, count=None)
+    faults.add("timeout", backend="jax", at=4, count=None)
+    _, got = _run_dual(_sup_cfg())
+    assert events.get_events("backend_demoted")
+    assert got == expected  # Consensus __eq__ covers sequence AND scores
+    assert got[0].is_dual() == expected[0].is_dual()
+
+
+def test_timeout_demotion_priority_byte_identical(faults):
+    _, expected = _run_priority(_cfg())
+    # unlimited timeouts on the jax backend: every supervisor the
+    # priority engine constructs (one per chain level) demotes
+    faults.add("timeout", backend="jax", count=None)
+    _, got = _run_priority(_sup_cfg())
+    assert events.get_events("backend_demoted")
+    assert got == expected
+
+
+def test_device_loss_demotion_single_byte_identical(faults):
+    _, expected = _run_single(_cfg())
+    faults.add("device_loss", backend="jax", at=3, count=None)
+    faults.add("device_loss", backend="jax", at=4, count=None)
+    _, got = _run_single(_sup_cfg())
+    assert events.get_events("backend_demoted")
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+# -------------------------------------------------------- retry w/o demotion
+
+
+def test_transient_fault_retried_without_demotion(faults):
+    _, expected = _run_single(_cfg())
+    faults.add("device_loss", backend="jax", at=3, count=1)
+    _, got = _run_single(_sup_cfg())
+    assert len(events.get_events("dispatch_failed")) == 1
+    assert events.get_events("backend_demoted") == []
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+def test_garbage_stats_caught_by_validation(faults):
+    _, expected = _run_single(_cfg())
+    # the dispatch RUNS, then its BranchStats are corrupted to NaN; the
+    # supervisor's validation must refuse the result and retry
+    faults.add("garbage", backend="jax", op="stats", count=1)
+    _, got = _run_single(_sup_cfg())
+    failed = events.get_events("dispatch_failed")
+    assert failed and "GarbageStats" in failed[0]["error"]
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+def test_breaker_trips_before_retries_exhaust(faults):
+    # retries would allow 5 attempts, but 2 consecutive failures trip
+    # the breaker first
+    faults.add("timeout", backend="jax", count=None)
+    cfg = _sup_cfg(dispatch_retries=5, breaker_threshold=2)
+    _run_single(cfg)
+    demotions = events.get_events("backend_demoted")
+    assert demotions and demotions[0]["to_backend"] == "python"
+    assert len(events.get_events("dispatch_failed")) == 2
+
+
+def test_chain_exhaustion_raises_backend_failure(faults):
+    faults.add("timeout", count=None)  # every backend, every dispatch
+    scorer = make_scorer(list(SINGLE_READS), _sup_cfg(dispatch_retries=0,
+                                                      breaker_threshold=1))
+    assert isinstance(scorer, BackendSupervisor)
+    with pytest.raises(BackendFailure):
+        scorer.root(np.ones(len(SINGLE_READS), dtype=bool))
+
+
+# ------------------------------------------------------------ re-promotion
+
+
+def test_repromotion_probe_returns_to_preferred_backend(faults):
+    _, expected = _run_single(_cfg())
+    faults.add("timeout", backend="jax", at=0, count=None)
+    faults.add("timeout", backend="jax", at=1, count=None)
+    _, got = _run_single(_sup_cfg(repromote_after=5))
+    assert events.get_events("backend_demoted")
+    promotions = events.get_events("backend_promoted")
+    assert promotions and promotions[0]["to_backend"] == "jax"
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+def test_failed_probe_backs_off_and_search_completes(faults):
+    _, expected = _run_single(_cfg())
+    faults.add("timeout", backend="jax", at=0, count=None)
+    faults.add("timeout", backend="jax", at=1, count=None)
+    # the re-promotion probe itself fails -> exponential probe backoff,
+    # the search stays demoted and still finishes byte-identically
+    faults.add("device_loss", backend="jax", op="probe", count=None)
+    _, got = _run_single(_sup_cfg(repromote_after=3))
+    assert events.get_events("probe_failed")
+    assert events.get_events("backend_promoted") == []
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+# --------------------------------------------------------------- watchdog
+
+
+def test_watchdog_strict_raises_over_budget(faults):
+    with pytest.raises(WatchdogError):
+        _run_single(_cfg(dispatch_budget=1, watchdog_strict=True))
+
+
+def test_watchdog_default_warns_over_budget(faults, caplog):
+    with caplog.at_level(logging.WARNING, logger="waffle_con_tpu"):
+        _, results = _run_single(_cfg(dispatch_budget=1))
+    assert results  # search completed despite the violation
+    assert events.get_events("watchdog_budget_exceeded")
+    assert any(
+        "over" in r.getMessage() and "budget" in r.getMessage()
+        for r in caplog.records
+    )
+
+
+def test_watchdog_env_strict_mode(faults, monkeypatch):
+    monkeypatch.setenv("WAFFLE_WATCHDOG", "strict")
+    with pytest.raises(WatchdogError):
+        _run_single(_cfg(dispatch_budget=1))
+
+
+@pytest.mark.parametrize("runner", [_run_single, _run_dual, _run_priority])
+def test_watchdog_passes_at_pinned_budget(faults, runner):
+    # pin the budget to the workload's actual dispatch count: strict
+    # mode must pass exactly at the pin (contract: > budget fails)
+    engine, _ = runner(_cfg())
+    pinned = dispatch_total(engine.last_search_stats["scorer_counters"])
+    assert pinned > 0
+    engine, _ = runner(_cfg(dispatch_budget=pinned, watchdog_strict=True))
+    assert (
+        dispatch_total(engine.last_search_stats["scorer_counters"]) == pinned
+    )
+
+
+# ------------------------------------------------- cache + pallas faults
+
+
+def test_injected_cache_corruption_quarantined(faults, tmp_path, caplog):
+    import jax
+
+    from waffle_con_tpu.utils.cache import (
+        QUARANTINE_DIR,
+        enable_compilation_cache,
+        quarantine_corrupt_entries,
+    )
+
+    cache_dir_before = jax.config.jax_compilation_cache_dir
+    path = str(tmp_path / "cache")
+    os.makedirs(path)
+    with open(os.path.join(path, "entry_a"), "wb") as f:
+        f.write(b"\x00" * 256)
+    quarantine_corrupt_entries(path)  # seal into the manifest
+    faults.add("cache_corrupt")
+    try:
+        with caplog.at_level(logging.WARNING, logger="waffle_con_tpu"):
+            assert enable_compilation_cache(path) == path  # no crash
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir_before)
+    assert events.get_events("cache_corruption_injected")
+    assert events.get_events("cache_quarantine")
+    # quarantined, not loadable: gone from the scan dir
+    assert not os.path.exists(os.path.join(path, "entry_a"))
+    assert os.path.exists(os.path.join(path, QUARANTINE_DIR, "entry_a"))
+    assert any("quarantined corrupt" in r.getMessage() for r in caplog.records)
+
+
+def test_pallas_compile_fault_raises_in_guard(faults):
+    faults.add("pallas_compile", count=1)
+    with pytest.raises(faults_mod.InjectedFault):
+        faults_mod.check_pallas(1)
+    faults_mod.check_pallas(1)  # count consumed: no longer armed

@@ -80,6 +80,49 @@ def test_dual_engine_empty_fallback_warning_logging(caplog):
     assert any("No consensus found" in m for m in msgs)
 
 
+def test_single_engine_progress_trace(caplog, monkeypatch):
+    # the interval is a module global referenced at pop time, so a tiny
+    # value makes every pop emit the heartbeat line
+    import waffle_con_tpu.models.consensus as mod
+
+    monkeypatch.setattr(mod, "PROGRESS_LOG_INTERVAL", 1)
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = ConsensusDWFA(_cfg())
+        for seq in (b"ACGTACGT", b"ACGTACGT", b"ACCTACGT"):
+            engine.add_sequence(seq)
+        engine.consensus()
+    msgs = _formatted_messages(caplog)
+    assert any(m.startswith("search progress:") and "pops" in m for m in msgs)
+
+
+def test_dual_engine_progress_trace(caplog, monkeypatch):
+    import waffle_con_tpu.models.dual_consensus as mod
+
+    monkeypatch.setattr(mod, "PROGRESS_LOG_INTERVAL", 1)
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = DualConsensusDWFA(_cfg())
+        for seq in (b"ACGTACGT", b"ACGTACGT", b"ACTTACGT", b"ACTTACGT"):
+            engine.add_sequence(seq)
+        engine.consensus()
+    msgs = _formatted_messages(caplog)
+    assert any(m.startswith("search progress:") and "pops" in m for m in msgs)
+
+
+def test_priority_engine_progress_trace(caplog, monkeypatch):
+    import waffle_con_tpu.models.priority_consensus as mod
+
+    monkeypatch.setattr(mod, "PROGRESS_LOG_INTERVAL", 1)
+    with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
+        engine = PriorityConsensusDWFA(_cfg())
+        for chain in ([b"ACGT"], [b"ACGT"], [b"ACTT"], [b"ACTT"]):
+            engine.add_sequence_chain(chain)
+        engine.consensus()
+    msgs = _formatted_messages(caplog)
+    assert any(
+        m.startswith("search progress:") and "groups solved" in m for m in msgs
+    )
+
+
 def test_priority_engine_debug_logging(caplog):
     with caplog.at_level(logging.DEBUG, logger="waffle_con_tpu"):
         engine = PriorityConsensusDWFA(_cfg())

@@ -108,6 +108,40 @@ class CdwfaConfig:
     #: when those nodes are actually popped.  1 disables speculation.
     #: Framework extension beyond the reference config.
     prefetch_width: int = 16
+    #: Route every scorer dispatch through the fault-tolerant
+    #: :class:`~waffle_con_tpu.runtime.supervisor.BackendSupervisor`
+    #: (timeout, retry/backoff, mid-search backend demotion).  Implied
+    #: by setting ``backend_chain``.  Framework extension.
+    supervised: bool = False
+    #: Explicit fallback chain for the supervisor, e.g. ``("jax",
+    #: "python")``.  ``None`` derives the health-ordered suffix from
+    #: ``backend`` (jax -> native -> python).  Framework extension.
+    backend_chain: Optional[tuple] = None
+    #: Wall-clock budget per blocking dispatch before the supervisor
+    #: declares it hung (seconds; ``None`` disables the timer — injected
+    #: fault timeouts still work).  Framework extension.
+    dispatch_timeout_s: Optional[float] = None
+    #: Retries per dispatch on the current backend before the
+    #: supervisor demotes.  Framework extension.
+    dispatch_retries: int = 2
+    #: Base delay of the exponential retry backoff (seconds).
+    retry_backoff_s: float = 0.05
+    #: Uniform-random jitter fraction added to each backoff delay.
+    retry_jitter: float = 0.25
+    #: Circuit breaker: consecutive dispatch failures (across ops)
+    #: before the supervisor demotes the live search.
+    breaker_threshold: int = 3
+    #: After this many clean dispatches on a demoted backend, probe the
+    #: next-better backend for re-promotion (doubling on each failed
+    #: probe).  ``None`` disables re-promotion.  Framework extension.
+    repromote_after: Optional[int] = None
+    #: Engagement watchdog: pinned blocking-dispatch budget for one
+    #: ``consensus()`` search (summed over ``DISPATCH_COUNTER_KEYS``);
+    #: ``None`` disables the check.  Framework extension.
+    dispatch_budget: Optional[int] = None
+    #: Watchdog strict mode: raise ``WatchdogError`` instead of warning
+    #: when the dispatch budget is exceeded.  Framework extension.
+    watchdog_strict: bool = False
 
     def __post_init__(self) -> None:
         if self.wildcard is not None and not 0 <= self.wildcard <= 255:
@@ -120,6 +154,28 @@ class CdwfaConfig:
             raise ValueError("prefetch_width must be >= 1")
         if self.initial_band is not None and self.initial_band < 1:
             raise ValueError("initial_band must be >= 1")
+        if self.backend_chain is not None:
+            chain = tuple(self.backend_chain)
+            if not chain:
+                raise ValueError("backend_chain must not be empty")
+            for b in chain:
+                if b not in ("python", "native", "jax"):
+                    raise ValueError(f"unknown backend {b!r} in chain")
+            if len(set(chain)) != len(chain):
+                raise ValueError("backend_chain entries must be unique")
+            object.__setattr__(self, "backend_chain", chain)
+        if self.dispatch_timeout_s is not None and self.dispatch_timeout_s <= 0:
+            raise ValueError("dispatch_timeout_s must be positive")
+        if self.dispatch_retries < 0:
+            raise ValueError("dispatch_retries must be >= 0")
+        if self.retry_backoff_s < 0 or self.retry_jitter < 0:
+            raise ValueError("retry backoff and jitter must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.repromote_after is not None and self.repromote_after < 1:
+            raise ValueError("repromote_after must be >= 1")
+        if self.dispatch_budget is not None and self.dispatch_budget < 1:
+            raise ValueError("dispatch_budget must be >= 1")
 
 
 class CdwfaConfigBuilder:

@@ -40,6 +40,10 @@ logger = logging.getLogger(__name__)
 #: bookkeeping simulation; long clean stretches simply re-engage next pop.
 RUN_SIM_CAP = 65536
 
+#: Queue pops between search-progress debug lines (reference parity:
+#: ``/root/reference/src/dual_consensus.rs:403-414``); tests shrink it.
+PROGRESS_LOG_INTERVAL = 1000
+
 
 class EngineError(Exception):
     """Engine-level failure (coverage gaps, invalid inputs, ...).
@@ -440,6 +444,7 @@ class ConsensusDWFA:
         pqueue.push(root.key(), root, root.priority(cost))
 
         results: List[Consensus] = []
+        pops = 0
 
         while not pqueue.is_empty():
             peak_queue_size = max(peak_queue_size, len(pqueue))
@@ -452,6 +457,13 @@ class ConsensusDWFA:
                 last_constraint = 0
 
             node, priority = pqueue.pop()
+            pops += 1
+            if pops % PROGRESS_LOG_INTERVAL == 0:
+                logger.debug(
+                    "search progress: %d pops, queue=%d, farthest=%d, "
+                    "best_cost=%d", pops, len(pqueue), farthest_consensus,
+                    -priority[0],
+                )
             top_cost = -priority[0]
             top_len = len(node.consensus)
             tracker.remove(top_len)
@@ -732,6 +744,11 @@ class ConsensusDWFA:
             "peak_queue_size": peak_queue_size,
             "scorer_counters": dict(getattr(scorer, "counters", {})),
         }
+        from waffle_con_tpu.runtime.watchdog import enforce_dispatch_budget
+
+        enforce_dispatch_budget(
+            cfg, self.last_search_stats["scorer_counters"], "single"
+        )
         return results
 
     # ------------------------------------------------------------------

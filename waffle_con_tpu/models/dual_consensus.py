@@ -19,6 +19,7 @@ import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.models.consensus import (
+    PROGRESS_LOG_INTERVAL,
     RUN_SIM_CAP,
     Consensus,
     EngineError,
@@ -450,6 +451,7 @@ class DualConsensusDWFA:
                 cfg.min_count, math.ceil(cfg.min_af * _tot)
             )
 
+        pops = 0
         while not pqueue.is_empty():
             while (
                 len(single_tracker) > cfg.max_queue_size
@@ -465,6 +467,13 @@ class DualConsensusDWFA:
                 dual_last_constraint = 0
 
             node, priority = pqueue.pop()
+            pops += 1
+            if pops % PROGRESS_LOG_INTERVAL == 0:
+                logger.debug(
+                    "search progress: %d pops, queue=%d, farthest=%d/%d, "
+                    "best_cost=%d", pops, len(pqueue), farthest_single,
+                    farthest_dual, -priority[0],
+                )
             top_cost = -priority[0]
             top_len = node.max_consensus_length()
 
@@ -919,6 +928,11 @@ class DualConsensusDWFA:
                 for k, v in counters_after.items()
             },
         }
+        from waffle_con_tpu.runtime.watchdog import enforce_dispatch_budget
+
+        enforce_dispatch_budget(
+            cfg, self.last_search_stats["scorer_counters"], "dual"
+        )
         return results
 
     # ==================================================================

@@ -16,6 +16,7 @@ from typing import List, Optional, Set, Tuple
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.models.consensus import (
+    PROGRESS_LOG_INTERVAL,
     Consensus,
     EngineError,
     check_invariant,
@@ -137,10 +138,18 @@ class PriorityConsensusDWFA:
         merged_counters: dict = {}
         scorer_constructions = 0
         share_scorer = self.config.backend == "jax"
+        groups_solved = 0
         while to_split:
             include_set = to_split.pop()
             current_split_level = split_levels.pop()
             current_chain = consensus_chains.pop()
+            groups_solved += 1
+            if groups_solved % PROGRESS_LOG_INTERVAL == 0:
+                logger.debug(
+                    "search progress: %d groups solved, worklist=%d, "
+                    "level=%d", groups_solved, len(to_split),
+                    current_split_level,
+                )
 
             injected = None
             if share_scorer:
@@ -226,6 +235,9 @@ class PriorityConsensusDWFA:
             "scorer_counters": merged_counters,
             "scorer_constructions": scorer_constructions,
         }
+        from waffle_con_tpu.runtime.watchdog import enforce_dispatch_budget
+
+        enforce_dispatch_budget(self.config, merged_counters, "priority")
 
         if len(consensuses) > 1:
             indices = [-1] * len(self.sequences)

@@ -2708,6 +2708,9 @@ class JaxScorer(WavefrontScorer):
         the XLA while-loop path."""
         key = "run_pallas_calls" if sides == 1 else "run_dual_pallas_calls"
         try:
+            from waffle_con_tpu.runtime import faults
+
+            faults.check_pallas(sides)
             out = fn(*args)
         except Exception:
             logger.warning(
@@ -2715,6 +2718,9 @@ class JaxScorer(WavefrontScorer):
                 "XLA path", sides, exc_info=True,
             )
             self._pallas_kernel_ok[sides] = False
+            from waffle_con_tpu.runtime import events
+
+            events.record("pallas_kernel_disabled", sides=sides)
             return None
         self.counters[key] = self.counters.get(key, 0) + 1
         return out

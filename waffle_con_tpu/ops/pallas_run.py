@@ -63,16 +63,25 @@ def pallas_mode() -> str:
     process from WAFFLE_PALLAS (default: on iff a TPU is attached)."""
     env = os.environ.get("WAFFLE_PALLAS", "auto")
     if env == "0":
-        return "off"
-    if env == "interpret":
-        return "interpret"
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:  # pragma: no cover - no backend at all
-        return "off"
-    if platform == "tpu":
-        return "tpu"
-    return "interpret" if env == "1" else "off"
+        mode = "off"
+    elif env == "interpret":
+        mode = "interpret"
+    else:
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:  # pragma: no cover - no backend at all
+            platform = None
+        if platform == "tpu":
+            mode = "tpu"
+        else:
+            mode = "interpret" if env == "1" else "off"
+    # the round-5 driver bench silently fell back to CPU with
+    # run_pallas_calls == 0; stamping the resolved mode into the runtime
+    # event log makes "pallas never even attempted" visible in evidence
+    from waffle_con_tpu.runtime import events
+
+    events.record("pallas_mode", mode=mode)
+    return mode
 
 
 def fits_budget(stage_rows: int, R: int, W: int, C: int,

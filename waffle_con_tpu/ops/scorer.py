@@ -121,6 +121,10 @@ class WavefrontScorer:
         self.sym_id: Dict[int, int] = {
             int(s): i for i, s in enumerate(self.symtab)
         }
+        #: dispatch accounting (see ``DISPATCH_COUNTER_KEYS``); device
+        #: backends extend this with their own keys, and the runtime
+        #: watchdog enforces budgets over it
+        self.counters: Dict[str, int] = {}
 
     @property
     def num_reads(self) -> int:
@@ -222,6 +226,7 @@ class PythonScorer(WavefrontScorer):
         return self._new_handle(dwfas)
 
     def clone(self, h: int) -> int:
+        self._count("clone_calls")
         return self._new_handle(
             [dw.clone() if dw is not None else None for dw in self._branches[h]]
         )
@@ -229,7 +234,11 @@ class PythonScorer(WavefrontScorer):
     def free(self, h: int) -> None:
         self._branches.pop(h, None)
 
+    def _count(self, key: str) -> None:
+        self.counters[key] = self.counters.get(key, 0) + 1
+
     def push(self, h: int, consensus: bytes) -> BranchStats:
+        self._count("push_calls")
         dwfas = self._branches[h]
         for read, dw in zip(self.reads, dwfas):
             if dw is not None:
@@ -237,9 +246,11 @@ class PythonScorer(WavefrontScorer):
         return self._snapshot(dwfas, consensus)
 
     def stats(self, h: int, consensus: bytes) -> BranchStats:
+        self._count("stats_calls")
         return self._snapshot(self._branches[h], consensus)
 
     def activate(self, h: int, read_index: int, offset: int, consensus: bytes) -> None:
+        self._count("activate_calls")
         dwfas = self._branches[h]
         assert dwfas[read_index] is None
         cfg = self.config
@@ -252,6 +263,7 @@ class PythonScorer(WavefrontScorer):
         self._branches[h][read_index] = None
 
     def finalized_eds(self, h: int, consensus: bytes) -> np.ndarray:
+        self._count("finalize_calls")
         eds = np.zeros(self.num_reads, dtype=np.int64)
         for r, dw in enumerate(self._branches[h]):
             if dw is not None:
@@ -310,16 +322,6 @@ class SubsetScorer(WavefrontScorer):
         self.config = base.config
         self.symtab = base.symtab
         self.sym_id = base.sym_id
-        # engines feature-test these with getattr(..., None); shadow the
-        # forwarding methods when the base lacks the device fast path
-        if not hasattr(base, "run_extend"):
-            self.run_extend = None  # type: ignore[assignment]
-        if not hasattr(base, "run_extend_dual"):
-            self.run_extend_dual = None  # type: ignore[assignment]
-        if not hasattr(base, "run_arena"):
-            self.run_arena = None  # type: ignore[assignment]
-        if not hasattr(base, "clone_push_many"):
-            self.clone_push_many = None  # type: ignore[assignment]
 
     @property
     def ARENA_CAP(self):
@@ -378,7 +380,17 @@ class SubsetScorer(WavefrontScorer):
     def stats(self, h: int, consensus: bytes) -> BranchStats:
         return self._slice(self.base.stats(h, consensus))
 
-    def clone_push_many(self, specs):
+    @property
+    def clone_push_many(self):
+        # engines feature-test the fast paths with getattr(..., None)
+        # EVERY pop; forwarding dynamically (rather than shadowing at
+        # construction) keeps this view correct when a supervised base
+        # changes backend mid-search
+        if getattr(self.base, "clone_push_many", None) is None:
+            return None
+        return self._clone_push_many
+
+    def _clone_push_many(self, specs):
         return [
             (h, self._slice(s) if s is not None else None)
             for h, s in self.base.clone_push_many(specs)
@@ -411,8 +423,26 @@ class SubsetScorer(WavefrontScorer):
             offset_compare_length, wildcard,
         )
 
-    # -- device fast paths (shadowed with None when the base lacks them)
-    def run_extend(self, h, consensus, *args, **kwargs):
+    # -- device fast paths (None when the current base lacks them)
+    @property
+    def run_extend(self):
+        if getattr(self.base, "run_extend", None) is None:
+            return None
+        return self._run_extend
+
+    @property
+    def run_extend_dual(self):
+        if getattr(self.base, "run_extend_dual", None) is None:
+            return None
+        return self._run_extend_dual
+
+    @property
+    def run_arena(self):
+        if getattr(self.base, "run_arena", None) is None:
+            return None
+        return self._run_arena
+
+    def _run_extend(self, h, consensus, *args, **kwargs):
         steps, code, appended, stats, records = self.base.run_extend(
             h, consensus, *args, **kwargs
         )
@@ -425,7 +455,7 @@ class SubsetScorer(WavefrontScorer):
             [(j, fin[idx]) for j, fin in records],
         )
 
-    def run_extend_dual(self, h1, h2, consensus1, consensus2, *args, **kwargs):
+    def _run_extend_dual(self, h1, h2, consensus1, consensus2, *args, **kwargs):
         (steps, code, app1, app2, stats1, stats2, act1, act2, records) = (
             self.base.run_extend_dual(h1, h2, consensus1, consensus2, *args, **kwargs)
         )
@@ -445,7 +475,7 @@ class SubsetScorer(WavefrontScorer):
             ],
         )
 
-    def run_arena(self, *args, **kwargs):
+    def _run_arena(self, *args, **kwargs):
         (events, nsteps, code, stop_node, node_steps, appended,
          sides_stats, sides_act, alive, creations) = self.base.run_arena(
             *args, **kwargs
@@ -461,21 +491,34 @@ class SubsetScorer(WavefrontScorer):
         )
 
 
-def make_scorer(reads: Sequence[bytes], config: CdwfaConfig) -> WavefrontScorer:
-    """Instantiate the scorer selected by ``config.backend``."""
-    if config.backend == "python":
+def construct_backend(
+    reads: Sequence[bytes], config: CdwfaConfig, backend: str
+) -> WavefrontScorer:
+    """Instantiate one concrete backend scorer (the supervisor calls
+    this directly to build fallback scorers mid-search)."""
+    if backend == "python":
         return PythonScorer(reads, config)
-    if config.backend == "jax":
+    if backend == "jax":
         from waffle_con_tpu.ops.jax_scorer import JaxScorer
 
         scorer = JaxScorer(reads, config)
         if config.mesh_shards:
-            from waffle_con_tpu.parallel import make_mesh, shard_scorer
+            from waffle_con_tpu.parallel import shard_for_config
 
-            shard_scorer(scorer, make_mesh(config.mesh_shards))
+            shard_for_config(scorer, config)
         return scorer
-    if config.backend == "native":
+    if backend == "native":
         from waffle_con_tpu.native import NativeScorer
 
         return NativeScorer(reads, config)
-    raise ValueError(f"unknown backend {config.backend!r}")
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def make_scorer(reads: Sequence[bytes], config: CdwfaConfig) -> WavefrontScorer:
+    """Instantiate the scorer selected by ``config.backend``, wrapped in
+    the fault-tolerant supervisor when the config asks for one."""
+    if config.supervised or config.backend_chain is not None:
+        from waffle_con_tpu.runtime.supervisor import BackendSupervisor
+
+        return BackendSupervisor(reads, config)
+    return construct_backend(reads, config, config.backend)

@@ -3,8 +3,11 @@ reduction."""
 
 from waffle_con_tpu.parallel.mesh import (
     make_mesh,
+    shard_for_config,
     shard_scorer,
     sharded_col_step,
 )
 
-__all__ = ["make_mesh", "shard_scorer", "sharded_col_step"]
+__all__ = [
+    "make_mesh", "shard_for_config", "shard_scorer", "sharded_col_step",
+]

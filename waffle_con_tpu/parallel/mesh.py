@@ -39,6 +39,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from waffle_con_tpu.ops.jax_scorer import _col_step, _stats_core
 
+# jax.shard_map only exists from jax 0.5; older versions (this container
+# ships 0.4.x) keep it under the experimental namespace
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def make_mesh(
     n_devices: Optional[int] = None,
@@ -114,6 +121,22 @@ def shard_scorer(scorer, mesh: Mesh, read_axis: str = "read") -> None:
     scorer._rlen = jax.device_put(
         scorer._rlen, NamedSharding(mesh, P(read_axis))
     )
+    from waffle_con_tpu.runtime import events
+
+    events.record(
+        "scorer_sharded", axis=read_axis, shards=n,
+        reads=int(scorer._R),
+    )
+
+
+def shard_for_config(scorer, config) -> None:
+    """Apply ``config.mesh_shards`` sharding to a fresh ``JaxScorer``.
+
+    One place for the make-a-mesh-and-shard snippet so the supervisor's
+    mid-search fallback construction places state exactly like
+    ``make_scorer`` does."""
+    if config.mesh_shards:
+        shard_scorer(scorer, make_mesh(config.mesh_shards))
 
 
 def sharded_col_step(mesh: Mesh, read_axis: str = "read", num_symbols: int = 32):
@@ -150,7 +173,7 @@ def sharded_col_step(mesh: Mesh, read_axis: str = "read", num_symbols: int = 32)
 
     rspec = P(read_axis)
     rwspec = P(read_axis, None)
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         body,
         mesh=mesh,
         in_specs=(

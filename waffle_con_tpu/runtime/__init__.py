@@ -1,0 +1,52 @@
+"""Fault-tolerant backend runtime.
+
+The north star is a serving-grade TPU consensus path, and serving-grade
+means the device layer is allowed to misbehave: tunnel flaps, hung
+dispatches, garbage tensors from a sick accelerator, a corrupted
+persistent compile cache.  This package makes those first-class inputs
+instead of crashes:
+
+* :mod:`~waffle_con_tpu.runtime.supervisor` —
+  :class:`~waffle_con_tpu.runtime.supervisor.BackendSupervisor`, a
+  ``WavefrontScorer`` that wraps every blocking dispatch of a real
+  backend with timeout + bounded retry + a circuit breaker, and demotes
+  a live search down a health-ordered backend chain (pallas/TPU →
+  jax-CPU → C++ native → Python oracle) mid-search with byte-identical
+  results.
+* :mod:`~waffle_con_tpu.runtime.faults` — deterministic fault injection
+  (env or programmatic): dispatch timeouts, device-loss exceptions,
+  NaN/garbage score tensors, pallas compile failures, compile-cache
+  corruption.
+* :mod:`~waffle_con_tpu.runtime.watchdog` — per-engine dispatch-budget
+  accounting over the scorer counters, turning silent fast-path
+  engagement regressions into loud warnings (or failures in strict
+  mode).
+* :mod:`~waffle_con_tpu.runtime.events` — the process-wide runtime
+  event log every component above records into; ``bench.py`` ships it
+  in the evidence JSON.
+"""
+
+from waffle_con_tpu.runtime.events import (  # noqa: F401
+    clear_events,
+    get_events,
+    record,
+)
+from waffle_con_tpu.runtime.faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    InjectedDeviceLoss,
+    InjectedFault,
+    InjectedTimeout,
+)
+from waffle_con_tpu.runtime.supervisor import (  # noqa: F401
+    BackendFailure,
+    BackendSupervisor,
+    DispatchTimeout,
+    GarbageStats,
+    effective_chain,
+)
+from waffle_con_tpu.runtime.watchdog import (  # noqa: F401
+    WatchdogError,
+    dispatch_total,
+    enforce_dispatch_budget,
+)

@@ -1,0 +1,56 @@
+"""Process-wide runtime event log.
+
+A single append-only list shared by the supervisor (demotions,
+promotions, dispatch failures), the compile cache (quarantined
+entries), the pallas guard (kernel disables), and the watchdog (budget
+violations).  Tests assert on it, and ``bench.py`` records it in the
+evidence JSON so a degraded run is visibly degraded.
+
+Events are plain dicts with a ``kind`` key; everything else is
+kind-specific detail.  The log is intentionally unbounded-ish but
+capped defensively: a pathological retry loop must not turn the event
+log itself into the memory leak.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+_LOCK = threading.Lock()
+_EVENTS: List[Dict] = []
+#: hard cap; beyond it new events replace a marker rather than growing
+_MAX_EVENTS = 10_000
+
+
+def record(kind: str, **details) -> Dict:
+    """Append an event and return it."""
+    event = {"kind": kind, **details}
+    with _LOCK:
+        if len(_EVENTS) < _MAX_EVENTS:
+            _EVENTS.append(event)
+        elif _EVENTS[-1].get("kind") != "event_log_saturated":
+            _EVENTS.append({"kind": "event_log_saturated"})
+    return event
+
+
+def get_events(kind: Optional[str] = None) -> List[Dict]:
+    """Snapshot of recorded events (optionally filtered by kind)."""
+    with _LOCK:
+        return [
+            dict(e) for e in _EVENTS if kind is None or e["kind"] == kind
+        ]
+
+
+def summarize_events() -> Dict[str, int]:
+    """``{kind: count}`` — the compact form bench.py embeds per line."""
+    with _LOCK:
+        out: Dict[str, int] = {}
+        for e in _EVENTS:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
+
+
+def clear_events() -> None:
+    with _LOCK:
+        del _EVENTS[:]

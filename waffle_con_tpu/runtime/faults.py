@@ -1,0 +1,253 @@
+"""Deterministic fault injection for the backend runtime.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` rules; each rule
+fires when a supervised dispatch matches its ``(kind, backend, op,
+at)`` filter, at most ``count`` times.  Dispatch indices are the
+supervisor's monotonically increasing attempt counter, so a plan is
+exactly reproducible: the same workload sees the same faults at the
+same dispatches on every run.
+
+Plans come from two places:
+
+* programmatically (tests): ``faults.install(FaultPlan()).add(...)``
+  — see the ``faults`` fixture in ``conftest.py``;
+* the environment (whole-process injection, e.g. under ``bench.py`` or
+  a child of ``scripts/run_suite.py``)::
+
+      WAFFLE_FAULTS="timeout:jax:*:5:1,device_loss:jax:run:12"
+
+  Comma-separated ``kind[:backend[:op[:at[:count]]]]`` rules with ``*``
+  wildcards; ``at`` empty/``*`` means "every matching dispatch",
+  ``count`` empty/``*`` means unlimited.
+
+Fault kinds:
+
+* ``timeout`` — the supervisor raises
+  :class:`~waffle_con_tpu.runtime.supervisor.DispatchTimeout` before
+  touching the backend (state provably unmutated, so retry is safe).
+* ``device_loss`` — :class:`InjectedDeviceLoss` before the backend
+  call, modelling a vanished device / dead tunnel.
+* ``garbage`` — the dispatch runs, then every ``BranchStats`` in the
+  result is corrupted to NaN; the supervisor's validation must catch
+  it and recover from the pre-call ledger state.
+* ``pallas_compile`` — ``JaxScorer._pallas_guarded`` raises as if
+  Mosaic lowering failed, exercising the per-kernel XLA fallback.
+* ``cache_corrupt`` — ``enable_compilation_cache`` flips bytes in one
+  persistent cache entry before integrity verification runs,
+  modelling on-disk corruption from a crashed writer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from waffle_con_tpu.runtime import events
+
+FAULT_KINDS = (
+    "timeout", "device_loss", "garbage", "pallas_compile", "cache_corrupt",
+)
+
+
+class InjectedFault(Exception):
+    """Base class for exceptions raised by injected faults."""
+
+
+class InjectedTimeout(InjectedFault):
+    """Injected dispatch timeout (raised before the backend runs)."""
+
+
+class InjectedDeviceLoss(InjectedFault):
+    """Injected device-loss / dead-tunnel failure."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One injection rule.  ``backend``/``op`` filter with ``"*"`` as
+    the wildcard; ``at`` pins a single dispatch index (``None`` = every
+    matching dispatch); ``count`` bounds total firings (``None`` =
+    unlimited)."""
+
+    kind: str
+    backend: str = "*"
+    op: str = "*"
+    at: Optional[int] = None
+    count: Optional[int] = 1
+    fired: int = 0
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (known: {FAULT_KINDS})"
+            )
+
+    def _exhausted(self) -> bool:
+        return self.count is not None and self.fired >= self.count
+
+    def matches(self, backend: str, op: str, index: Optional[int]) -> bool:
+        if self._exhausted():
+            return False
+        if self.backend != "*" and self.backend != backend:
+            return False
+        if self.op != "*" and self.op != op:
+            return False
+        if self.at is not None and index != self.at:
+            return False
+        return True
+
+
+class FaultPlan:
+    """An ordered set of fault rules consulted by the runtime hooks."""
+
+    def __init__(self, specs: Optional[List[FaultSpec]] = None) -> None:
+        self.specs: List[FaultSpec] = list(specs or [])
+
+    def add(
+        self,
+        kind: str,
+        backend: str = "*",
+        op: str = "*",
+        at: Optional[int] = None,
+        count: Optional[int] = 1,
+    ) -> "FaultPlan":
+        self.specs.append(FaultSpec(kind, backend, op, at, count))
+        return self
+
+    def poll(
+        self, backend: str, op: str, index: Optional[int],
+        kinds: Optional[tuple] = None,
+    ) -> Optional[FaultSpec]:
+        """First matching rule (its firing consumed), or ``None``."""
+        for spec in self.specs:
+            if kinds is not None and spec.kind not in kinds:
+                continue
+            if spec.matches(backend, op, index):
+                spec.fired += 1
+                events.record(
+                    "fault_injected", fault=spec.kind, backend=backend,
+                    op=op, index=index,
+                )
+                return spec
+        return None
+
+
+#: the installed plan; ``None`` means "not yet resolved from the env"
+_ACTIVE: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+
+
+def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or with ``None``: clear) the process-wide fault plan."""
+    global _ACTIVE, _ENV_CHECKED
+    _ACTIVE = plan
+    _ENV_CHECKED = True  # an explicit install overrides the env
+    return plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    """The installed plan, lazily resolving ``WAFFLE_FAULTS`` once."""
+    global _ACTIVE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get("WAFFLE_FAULTS", "")
+        if spec:
+            _ACTIVE = plan_from_env(spec)
+    return _ACTIVE
+
+
+def plan_from_env(spec: str) -> FaultPlan:
+    """Parse a ``WAFFLE_FAULTS`` rule string (see module docstring)."""
+    plan = FaultPlan()
+    for rule in spec.split(","):
+        rule = rule.strip()
+        if not rule:
+            continue
+        parts = rule.split(":")
+        kind = parts[0]
+        backend = parts[1] if len(parts) > 1 and parts[1] else "*"
+        op = parts[2] if len(parts) > 2 and parts[2] else "*"
+
+        def _int(i: int) -> Optional[int]:
+            if len(parts) <= i or parts[i] in ("", "*"):
+                return None
+            return int(parts[i])
+
+        plan.add(kind, backend, op, at=_int(3), count=_int(4))
+    return plan
+
+
+def poll(backend: str, op: str, index: int) -> Optional[FaultSpec]:
+    """Supervisor-side hook: dispatch-targeted fault kinds only."""
+    plan = active()
+    if plan is None:
+        return None
+    return plan.poll(
+        backend, op, index, kinds=("timeout", "device_loss", "garbage")
+    )
+
+
+def check_pallas(sides: int) -> None:
+    """``_pallas_guarded`` hook: raise (inside its try block) when a
+    ``pallas_compile`` fault is armed for this kernel."""
+    plan = active()
+    if plan is None:
+        return
+    if plan.poll("jax", f"pallas{sides}", None, kinds=("pallas_compile",)):
+        raise InjectedFault(
+            f"injected pallas compile failure (sides={sides})"
+        )
+
+
+def maybe_corrupt_cache(path: str) -> Optional[str]:
+    """``enable_compilation_cache`` hook: when a ``cache_corrupt`` fault
+    is armed, flip bytes in the middle of the first cache entry (sorted
+    order — deterministic), returning the corrupted filename."""
+    plan = active()
+    if plan is None:
+        return None
+    if not plan.poll("cache", "enable", None, kinds=("cache_corrupt",)):
+        return None
+    try:
+        names = sorted(
+            n for n in os.listdir(path)
+            if os.path.isfile(os.path.join(path, n))
+            and not n.startswith(("MANIFEST", "_"))
+        )
+    except OSError:
+        return None
+    if not names:
+        return None
+    target = os.path.join(path, names[0])
+    with open(target, "r+b") as f:
+        data = f.read()
+        mid = len(data) // 2
+        f.seek(mid)
+        f.write(bytes(b ^ 0xFF for b in data[mid : mid + 16]) or b"\xff")
+    events.record("cache_corruption_injected", entry=names[0])
+    return names[0]
+
+
+def mangle_stats(result):
+    """Corrupt every ``BranchStats`` reachable in a dispatch result
+    (NaN distances, negative votes) — the ``garbage`` fault payload."""
+    from waffle_con_tpu.ops.scorer import BranchStats
+
+    def walk(obj):
+        if isinstance(obj, BranchStats):
+            obj.eds = np.full(np.shape(obj.eds), np.nan)
+            obj.split = np.full(np.shape(obj.split), -1, dtype=np.int64)
+            return obj
+        if isinstance(obj, list):
+            return [walk(x) for x in obj]
+        if isinstance(obj, tuple):
+            return tuple(walk(x) for x in obj)
+        return obj
+
+    return walk(result)

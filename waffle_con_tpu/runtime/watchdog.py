@@ -1,0 +1,67 @@
+"""Engagement watchdog: dispatch-budget accounting.
+
+The device fast paths (``run_extend``, arenas, fused clone+push) are
+what make the TPU path fast — and a silent regression to per-symbol
+dispatching passes every parity test while destroying performance
+(round-5 VERDICT weak #5).  Wall time on tunneled platforms is
+``blocking_dispatches x ~80 ms``, so the blocking-dispatch count IS
+the performance contract.  This module turns it into an enforced one:
+engines call :func:`enforce_dispatch_budget` at the end of every
+``consensus()`` with their scorer-counter totals; a workload that
+exceeds its pinned ``config.dispatch_budget`` warns by default and
+raises in strict mode (``config.watchdog_strict`` or
+``WAFFLE_WATCHDOG=strict``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS
+from waffle_con_tpu.runtime import events
+
+logger = logging.getLogger(__name__)
+
+
+class WatchdogError(RuntimeError):
+    """Strict-mode budget violation."""
+
+
+def dispatch_total(counters: Dict[str, int]) -> int:
+    """Blocking-dispatch count: the sum of the counter keys that each
+    correspond to one blocking device dispatch (``ops/scorer.py``)."""
+    return sum(int(counters.get(k, 0)) for k in DISPATCH_COUNTER_KEYS)
+
+
+def enforce_dispatch_budget(
+    config, counters: Dict[str, int], engine: str
+) -> Optional[int]:
+    """Check one search's dispatch count against its pinned budget.
+
+    Returns the total (``None`` when no budget is configured).  Over
+    budget: records a ``watchdog_budget_exceeded`` event and warns, or
+    raises :class:`WatchdogError` in strict mode.
+    """
+    budget = getattr(config, "dispatch_budget", None)
+    if budget is None:
+        return None
+    total = dispatch_total(counters)
+    if total > budget:
+        events.record(
+            "watchdog_budget_exceeded", engine=engine, total=total,
+            budget=budget,
+        )
+        message = (
+            f"{engine} consensus used {total} blocking dispatches, over "
+            f"its pinned budget of {budget} — a device fast path likely "
+            "disengaged (see counter breakdown in last_search_stats)"
+        )
+        strict = bool(getattr(config, "watchdog_strict", False)) or (
+            os.environ.get("WAFFLE_WATCHDOG") == "strict"
+        )
+        if strict:
+            raise WatchdogError(message)
+        logger.warning("%s", message)
+    return total

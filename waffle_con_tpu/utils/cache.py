@@ -3,13 +3,30 @@
 The scorer kernels recompile per geometry (band width, slot count, read
 count); the cache makes those compiles one-time per machine rather than
 per process — important on TPU where a single compile can take tens of
-seconds."""
+seconds.
+
+Entries are integrity-checked: a JSON manifest of content hashes rides
+next to the entries, and :func:`quarantine_corrupt_entries` moves any
+entry whose bytes no longer match (crashed writer, disk fault, injected
+corruption) into a ``_quarantine/`` subdirectory before JAX can load
+it — a quarantined kernel recompiles; a loaded corrupt one can segfault
+the process."""
 
 from __future__ import annotations
 
 import hashlib
+import json
+import logging
 import os
 import platform
+import shutil
+
+logger = logging.getLogger(__name__)
+
+#: manifest + quarantine live inside the cache dir; both invisible to
+#: JAX's entry scan (it only loads exact key filenames)
+MANIFEST_NAME = "MANIFEST.json"
+QUARANTINE_DIR = "_quarantine"
 
 
 def _host_fingerprint() -> str:
@@ -49,10 +66,92 @@ def _host_fingerprint() -> str:
     return hashlib.sha256(feats.encode()).hexdigest()[:12]
 
 
-def enable_compilation_cache(path: str | None = None) -> None:
+def _sha256_file(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def _cache_entries(path: str):
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if name == MANIFEST_NAME or name.startswith("."):
+            continue
+        if os.path.isfile(full):
+            yield name, full
+
+
+def _load_manifest(path: str) -> dict:
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        if not isinstance(manifest, dict):
+            raise ValueError("manifest is not a mapping")
+        return manifest
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as exc:
+        # a corrupt manifest is rebuilt from the surviving entries; the
+        # entries it would have vouched for get re-sealed below
+        logger.warning("rebuilding corrupt cache manifest: %r", exc)
+        return {}
+
+
+def _save_manifest(path: str, manifest: dict) -> None:
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=0, sort_keys=True)
+    os.replace(tmp, manifest_path)
+
+
+def quarantine_corrupt_entries(path: str) -> list:
+    """Verify every cache entry against the manifest; move mismatches
+    into ``_quarantine/`` (so the kernel recompiles instead of loading
+    corrupt machine code) and seal new entries into the manifest.
+    Returns the quarantined entry names."""
+    manifest = _load_manifest(path)
+    quarantined = []
+    changed = False
+    for name, full in _cache_entries(path):
+        digest = _sha256_file(full)
+        expected = manifest.get(name)
+        if expected is None:
+            manifest[name] = digest
+            changed = True
+            continue
+        if digest != expected:
+            qdir = os.path.join(path, QUARANTINE_DIR)
+            os.makedirs(qdir, exist_ok=True)
+            shutil.move(full, os.path.join(qdir, name))
+            del manifest[name]
+            changed = True
+            quarantined.append(name)
+            logger.warning(
+                "quarantined corrupt compilation-cache entry %s "
+                "(hash mismatch); it will recompile", name,
+            )
+            from waffle_con_tpu.runtime import events
+
+            events.record("cache_quarantine", entry=name)
+    # drop manifest rows whose entries vanished (evicted externally)
+    for name in list(manifest):
+        if not os.path.isfile(os.path.join(path, name)):
+            del manifest[name]
+            changed = True
+    if changed:
+        _save_manifest(path, manifest)
+    return quarantined
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``path`` (default
-    ``$JAX_CACHE_DIR`` or ``~/.cache/waffle_con_tpu_jax-<cpu-digest>``).
-    Safe to call multiple times."""
+    ``$JAX_CACHE_DIR`` or ``~/.cache/waffle_con_tpu_jax-<cpu-digest>``),
+    after integrity-checking the entries already there.  Safe to call
+    multiple times.  Returns the cache directory."""
     import jax
 
     if path is None:
@@ -65,5 +164,10 @@ def enable_compilation_cache(path: str | None = None) -> None:
             ),
         )
     os.makedirs(path, exist_ok=True)
+    from waffle_con_tpu.runtime import faults
+
+    faults.maybe_corrupt_cache(path)
+    quarantine_corrupt_entries(path)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path
