@@ -234,7 +234,60 @@ def _band_seed(seq_len, error_rate) -> int:
     return BAND_MARGIN + int(2 * error_rate * seq_len)
 
 
-def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5):
+# -- observability plumbing (obs subsystem) ---------------------------------
+#
+# With ``--trace-out FILE`` (or WAFFLE_TRACE/WAFFLE_METRICS in the env) the
+# timed runs record per-(backend, op) dispatch latency histograms and nested
+# search/dispatch/device-sync spans; the evidence JSON then carries a
+# ``metrics`` registry snapshot plus one SearchReport per timed iteration,
+# and FILE receives the Chrome trace of the SLOWEST iteration (the one worth
+# staring at in Perfetto).  Without any of those, the obs layer stays
+# uninstalled and the timed path is identical to an instrumentation-free run.
+
+
+def _obs_setup(trace_out):
+    """Enable metrics + tracing when ``--trace-out`` asks for them;
+    returns the live tracer (or ``None`` when tracing is off)."""
+    from waffle_con_tpu.obs import enable_metrics, get_tracer, tracing_enabled
+
+    if trace_out:
+        enable_metrics(True)
+        get_tracer().enable(True)
+    return get_tracer() if tracing_enabled() else None
+
+
+def _obs_iter_begin(tracer):
+    if tracer is not None:
+        tracer.clear()  # each timed iteration gets its own span buffer
+
+
+def _obs_iter_end(tracer, engine, dt, reports, slowest):
+    """Collect the iteration's SearchReport; keep the slowest
+    iteration's trace events.  Returns the updated ``slowest``."""
+    rep = getattr(engine, "last_search_report", None)
+    if rep is not None:
+        reports.append(rep.to_dict())
+    if tracer is not None and dt > slowest[0]:
+        return (dt, tracer.chrome_events())
+    return slowest
+
+
+def _obs_finish(out, tracer, trace_out, reports, slowest):
+    """Attach the obs evidence to the bench line and write the trace."""
+    from waffle_con_tpu.obs import metrics_enabled, registry
+
+    if reports:
+        out["search_report"] = reports[-1]
+        out["search_reports"] = reports
+    if metrics_enabled():
+        out["metrics"] = registry().snapshot()
+    if tracer is not None and trace_out:
+        tracer.write_chrome_trace(trace_out, events=slowest[1])
+        out["trace_out"] = trace_out
+
+
+def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
+                 trace_out=None):
     from waffle_con_tpu import CdwfaConfigBuilder
     from waffle_con_tpu.native import native_consensus
     from waffle_con_tpu.utils.example_gen import generate_test
@@ -271,11 +324,17 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5):
         import jax
 
         jax.profiler.start_trace(trace)
+    tracer = _obs_setup(trace_out)
     times = []
+    reports = []
+    slowest = (-1.0, None)
     for _ in range(max(1, iters)):
+        _obs_iter_begin(tracer)
         tpu_start = time.perf_counter()
         engine, tpu_results = tpu_run()
-        times.append(time.perf_counter() - tpu_start)
+        dt = time.perf_counter() - tpu_start
+        times.append(dt)
+        slowest = _obs_iter_end(tracer, engine, dt, reports, slowest)
     tpu_min, tpu_time = _time_stats(times)
     if trace:
         import jax
@@ -292,7 +351,7 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5):
             "arena_calls", "run_dual_calls",
         )
     )
-    return {
+    out = {
         "metric": f"consensus_{num_reads}x{seq_len}_wall_s",
         "value": round(tpu_time, 4),
         "value_min": round(tpu_min, 4),
@@ -329,9 +388,11 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5):
             "runtime_events": _runtime_events(),
         },
     }
+    _obs_finish(out, tracer, trace_out, reports, slowest)
+    return out
 
 
-def bench_dual(num_reads, seq_len, error_rate, iters=5):
+def bench_dual(num_reads, seq_len, error_rate, iters=5, trace_out=None):
     """Dual north-star: two haplotypes differing by 3 SNPs, half the reads
     each; CPU baseline is the complete C++ dual engine."""
     from waffle_con_tpu import CdwfaConfigBuilder
@@ -372,11 +433,17 @@ def bench_dual(num_reads, seq_len, error_rate, iters=5):
         return engine, engine.consensus()
 
     engine, tpu_results = tpu_run()
+    tracer = _obs_setup(trace_out)
     times = []
+    reports = []
+    slowest = (-1.0, None)
     for _ in range(max(1, iters)):
+        _obs_iter_begin(tracer)
         tpu_start = time.perf_counter()
         engine, tpu_results = tpu_run()
-        times.append(time.perf_counter() - tpu_start)
+        dt = time.perf_counter() - tpu_start
+        times.append(dt)
+        slowest = _obs_iter_end(tracer, engine, dt, reports, slowest)
     tpu_min, tpu_time = _time_stats(times)
 
     stats = getattr(engine, "last_search_stats", {})
@@ -389,7 +456,7 @@ def bench_dual(num_reads, seq_len, error_rate, iters=5):
             for c in tpu_results[:1]
         ),
     )
-    return {
+    out = {
         "metric": f"dual_{num_reads}x{seq_len}_wall_s",
         "value": round(tpu_time, 4),
         "value_min": round(tpu_min, 4),
@@ -427,9 +494,11 @@ def bench_dual(num_reads, seq_len, error_rate, iters=5):
             "runtime_events": _runtime_events(),
         },
     }
+    _obs_finish(out, tracer, trace_out, reports, slowest)
+    return out
 
 
-def bench_priority(num_reads, seq_len, error_rate, iters=5):
+def bench_priority(num_reads, seq_len, error_rate, iters=5, trace_out=None):
     """Priority north-star: 2-level chains splitting into two groups."""
     from waffle_con_tpu import CdwfaConfigBuilder
     from waffle_con_tpu.native import native_priority_consensus
@@ -463,17 +532,24 @@ def bench_priority(num_reads, seq_len, error_rate, iters=5):
     cpu_time = time.perf_counter() - cpu_start
 
     def tpu_run():
-        return _make_engine("priority", cfg("jax"), chains).consensus()
+        engine = _make_engine("priority", cfg("jax"), chains)
+        return engine, engine.consensus()
 
-    tpu_result = tpu_run()
+    engine, tpu_result = tpu_run()
+    tracer = _obs_setup(trace_out)
     times = []
+    reports = []
+    slowest = (-1.0, None)
     for _ in range(max(1, iters)):
+        _obs_iter_begin(tracer)
         tpu_start = time.perf_counter()
-        tpu_result = tpu_run()
-        times.append(time.perf_counter() - tpu_start)
+        engine, tpu_result = tpu_run()
+        dt = time.perf_counter() - tpu_start
+        times.append(dt)
+        slowest = _obs_iter_end(tracer, engine, dt, reports, slowest)
     tpu_min, tpu_time = _time_stats(times)
 
-    return {
+    out = {
         "metric": f"priority_{num_reads}x{seq_len}_wall_s",
         "value": round(tpu_time, 4),
         "value_min": round(tpu_min, 4),
@@ -486,6 +562,8 @@ def bench_priority(num_reads, seq_len, error_rate, iters=5):
         "groups": len(tpu_result.consensuses),
         "runtime_events": _runtime_events(),
     }
+    _obs_finish(out, tracer, trace_out, reports, slowest)
+    return out
 
 
 def _child_cmd(mode_args, platform):
@@ -614,6 +692,8 @@ def _north_star_orchestrated(args) -> None:
                 "--iters", str(args.iters)]
         if args.trace:
             mode += ["--trace", args.trace]
+        if args.trace_out:
+            mode += ["--trace-out", args.trace_out]
         label = f"attempt {num_reads}x{seq_len}@{platform}"
         result, msg = _run_child(mode, platform, timeout_s, label)
         if result is None:
@@ -734,6 +814,12 @@ def main() -> None:
     )
     parser.add_argument("--trace", default=None)
     parser.add_argument(
+        "--trace-out", dest="trace_out", default=None,
+        help="write a Chrome trace-event JSON (Perfetto-loadable) of the "
+        "slowest timed iteration, and embed a metrics snapshot + per-"
+        "iteration SearchReport in the evidence JSON",
+    )
+    parser.add_argument(
         "--platform", choices=("auto", "cpu", "device"), default="auto"
     )
     # hidden: one in-process bench attempt / gate run (orchestrator children)
@@ -759,6 +845,7 @@ def main() -> None:
             out = bench_single(
                 args.reads or 256, args.seq_len or 10_000, 0.01,
                 trace=args.trace, iters=args.iters,
+                trace_out=args.trace_out,
             )
             out["device_platform"] = _current_platform()
             print(json.dumps(out))
@@ -807,7 +894,8 @@ def main() -> None:
 
         enable_compilation_cache()
         out = bench_dual(
-            args.reads or 64, args.seq_len or 5000, 0.01, iters=args.iters
+            args.reads or 64, args.seq_len or 5000, 0.01, iters=args.iters,
+            trace_out=args.trace_out,
         )
         out["device_platform"] = _current_platform()
         print(json.dumps(out))
@@ -817,7 +905,8 @@ def main() -> None:
 
         enable_compilation_cache()
         out = bench_priority(
-            args.reads or 32, args.seq_len or 2000, 0.01, iters=args.iters
+            args.reads or 32, args.seq_len or 2000, 0.01, iters=args.iters,
+            trace_out=args.trace_out,
         )
         out["device_platform"] = _current_platform()
         print(json.dumps(out))

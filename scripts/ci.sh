@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# CI entry point: tier-1 test suite (per-file sharded) plus an
+# observability-enabled bench smoke whose evidence JSON and Chrome trace
+# are asserted to be well-formed.
+#
+# Usage: scripts/ci.sh [extra pytest args...]
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+export JAX_PLATFORMS=cpu
+
+echo "== tier-1 suite (sharded) =="
+python scripts/run_suite.py "$@"
+
+echo "== bench smoke (metrics + trace) =="
+SMOKE_OUT="$(mktemp /tmp/waffle_ci_bench.XXXXXX.json)"
+TRACE_OUT="$(mktemp /tmp/waffle_ci_trace.XXXXXX.json)"
+trap 'rm -f "$SMOKE_OUT" "$TRACE_OUT"' EXIT
+
+WAFFLE_METRICS=1 BENCH_SMOKE=1 \
+  BENCH_TOTAL_BUDGET="${BENCH_TOTAL_BUDGET:-600}" \
+  python bench.py --iters 5 --platform cpu --trace-out "$TRACE_OUT" \
+  > "$SMOKE_OUT"
+
+python - "$SMOKE_OUT" "$TRACE_OUT" <<'PY'
+import json
+import sys
+
+smoke_path, trace_path = sys.argv[1], sys.argv[2]
+
+with open(smoke_path) as fh:
+    evidence = json.loads(fh.read().strip().splitlines()[-1])
+assert "metric" in evidence, f"no metric in evidence: {sorted(evidence)}"
+assert "search_report" in evidence, (
+    f"no search_report in evidence: {sorted(evidence)}"
+)
+report = evidence["search_report"]
+for key in ("engine", "backend", "nodes_explored", "dispatch_total"):
+    assert key in report, f"search_report missing {key!r}: {sorted(report)}"
+assert "metrics" in evidence, f"no metrics snapshot: {sorted(evidence)}"
+latency = evidence["metrics"].get("waffle_dispatch_latency_seconds", {})
+assert latency.get("series"), "empty dispatch latency histograms"
+
+with open(trace_path) as fh:
+    trace = json.load(fh)
+events = trace.get("traceEvents", [])
+assert events, "empty Chrome trace"
+cats = {e.get("cat") for e in events}
+assert "search" in cats and "dispatch" in cats, f"missing span cats: {cats}"
+print(
+    f"ci bench smoke ok: {evidence['metric']}={evidence['value']}s, "
+    f"{len(events)} trace events, "
+    f"{len(latency['series'])} latency series"
+)
+PY
+
+echo "== ci.sh: all green =="

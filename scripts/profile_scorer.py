@@ -1,6 +1,12 @@
 #!/usr/bin/env python
 """Profile the JaxScorer device loop: steps/sec of run_extend, growth
-events, and per-call wall time, at a configurable problem size."""
+events, and per-call wall time, at a configurable problem size.
+
+Obs integration: with ``WAFFLE_METRICS=1`` the scorer is wrapped in the
+obs ``TimedScorer`` and a registry snapshot (per-op dispatch latency
+histograms) is printed at the end; with ``WAFFLE_TRACE=<path>`` the
+nested dispatch/device-sync spans are written there as a Chrome trace
+at exit."""
 
 import pathlib
 import sys
@@ -11,6 +17,8 @@ import numpy as np
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from waffle_con_tpu.config import CdwfaConfigBuilder
+from waffle_con_tpu.obs import metrics_enabled, registry
+from waffle_con_tpu.obs.instrument import maybe_instrument
 from waffle_con_tpu.ops.jax_scorer import JaxScorer
 from waffle_con_tpu.utils.example_gen import generate_test
 
@@ -23,7 +31,7 @@ def main():
     mc = max(2, R // 4)
     truth, reads = generate_test(4, L, R, err, seed=0)
     cfg = CdwfaConfigBuilder().min_count(mc).build()
-    sc = JaxScorer(reads, cfg)
+    sc = maybe_instrument(JaxScorer(reads, cfg), "jax")
     h = sc.root(np.ones(R, dtype=bool))
 
     cons = b""
@@ -65,6 +73,8 @@ def main():
         f"TOTAL: {total:.2f}s for {len(cons)} symbols in {calls} calls "
         f"({total/max(len(cons),1)*1e3:.3f} ms/symbol), final E={sc.bucket_e}"
     )
+    if metrics_enabled():
+        print(registry().render_prometheus(), end="")
 
 
 if __name__ == "__main__":

@@ -40,7 +40,33 @@ def test_single_engine_debug_logging(caplog):
     assert results[0].sequence == b"ACGTACGT"
     msgs = _formatted_messages(caplog)
     assert any(m.startswith("Offsets:") for m in msgs)
-    assert any(m.startswith("nodes_explored:") for m in msgs)
+    assert any(m.startswith("search summary:") for m in msgs)
+
+
+def test_search_summary_info_flag(caplog):
+    # log_search_summary promotes the one-line summary to INFO
+    with caplog.at_level(logging.INFO, logger="waffle_con_tpu"):
+        engine = ConsensusDWFA(_cfg(log_search_summary=True))
+        for seq in (b"ACGTACGT", b"ACGTACGT", b"ACCTACGT"):
+            engine.add_sequence(seq)
+        engine.consensus()
+    summaries = [
+        rec
+        for rec in caplog.records
+        if rec.getMessage().startswith("search summary:")
+    ]
+    assert summaries and summaries[0].levelno == logging.INFO
+
+
+def test_search_summary_debug_by_default(caplog):
+    # without the flag the summary must NOT appear at INFO
+    with caplog.at_level(logging.INFO, logger="waffle_con_tpu"):
+        engine = ConsensusDWFA(_cfg())
+        for seq in (b"ACGTACGT", b"ACGTACGT", b"ACCTACGT"):
+            engine.add_sequence(seq)
+        engine.consensus()
+    msgs = _formatted_messages(caplog)
+    assert not any(m.startswith("search summary:") for m in msgs)
 
 
 def test_single_engine_offset_shift_debug_logging(caplog):
@@ -63,7 +89,7 @@ def test_dual_engine_debug_logging(caplog):
     assert results and results[0].is_dual()
     msgs = _formatted_messages(caplog)
     assert any(m.startswith("Offsets:") for m in msgs)
-    assert any(m.startswith("nodes_explored:") for m in msgs)
+    assert any(m.startswith("search summary:") for m in msgs)
 
 
 def test_dual_engine_empty_fallback_warning_logging(caplog):

@@ -1,0 +1,328 @@
+"""Observability subsystem: tracer, metrics registry, search reports.
+
+Covers the obs contracts end to end: span nesting and Chrome export,
+the disabled-mode zero-allocation guarantee, histogram bucket math,
+Prometheus text exposition, the event-log saturation counter, dispatch
+instrumentation through ``construct_backend``, and supervisor demotion
+events landing in the metrics registry.
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import trace as obs_trace
+from waffle_con_tpu.obs.instrument import TimedScorer, maybe_instrument
+from waffle_con_tpu.obs.metrics import Histogram, MetricsRegistry
+from waffle_con_tpu.obs.report import SearchReport
+from waffle_con_tpu.obs.trace import NULL_SPAN, Tracer
+from waffle_con_tpu.ops.scorer import construct_backend
+from waffle_con_tpu.runtime import events
+
+SINGLE_READS = (b"ACGTACGT", b"ACGTACGT", b"ACCTACGT")
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().min_count(1).backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+@pytest.fixture
+def obs_on():
+    """Metrics + tracing force-enabled on a clean registry/tracer;
+    teardown restores the env-driven defaults so no obs state leaks."""
+    obs_metrics.enable_metrics(True)
+    obs_metrics.registry().reset()
+    tracer = obs_trace.get_tracer()
+    tracer.enable(True)
+    tracer.clear()
+    try:
+        yield tracer
+    finally:
+        obs_metrics.reset_metrics_enabled()
+        obs_metrics.registry().reset()
+        tracer.reset_enabled()
+        tracer.clear()
+
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_tracer_nested_spans_contained():
+    t = Tracer()
+    t.enable(True)
+    with t.span("outer", "search", engine="single"):
+        with t.span("inner", "dispatch", backend="jax"):
+            pass
+    evs = t.chrome_events()
+    assert [e["name"] for e in evs] == ["inner", "outer"]  # exit order
+    inner, outer = evs
+    # Chrome complete-event shape
+    for e in evs:
+        assert e["ph"] == "X"
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+    # the child's [ts, ts+dur] interval nests inside the parent's
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    assert inner["args"] == {"backend": "jax"}
+    totals = t.category_totals()
+    assert set(totals) == {"search", "dispatch"}
+    assert totals["search"] >= totals["dispatch"]
+
+
+def test_tracer_disabled_is_allocation_free():
+    t = Tracer()  # WAFFLE_TRACE unset in tier-1 runs -> disabled
+    t.enable(False)
+    s1 = t.span("a", "host")
+    s2 = t.span("b", "dispatch", key="value")
+    # the no-op singleton is shared: no per-span allocation at all
+    assert s1 is NULL_SPAN and s2 is NULL_SPAN
+    with s1:
+        pass
+    assert t.chrome_events() == []
+    assert t.category_totals() == {}
+
+
+def test_tracer_chrome_trace_file(tmp_path):
+    t = Tracer()
+    t.enable(True)
+    with t.span("search", "search"):
+        pass
+    path = tmp_path / "trace.json"
+    t.write_chrome_trace(str(path))
+    payload = json.loads(path.read_text())
+    assert payload["displayTimeUnit"] == "ms"
+    assert payload["traceEvents"][0]["name"] == "search"
+
+
+def test_tracer_clear_resets_events_and_totals():
+    t = Tracer()
+    t.enable(True)
+    with t.span("x", "host"):
+        pass
+    assert t.chrome_events()
+    t.clear()
+    assert t.chrome_events() == [] and t.category_totals() == {}
+
+
+# ------------------------------------------------------------- histograms
+
+
+def test_histogram_bucket_math():
+    h = Histogram(bounds=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.001, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    # bounds are inclusive upper edges; the last slot is +Inf overflow
+    assert h.counts == [2, 1, 1, 2]
+    assert h.cumulative() == [2, 3, 4, 6]
+    assert h.count == 6
+    assert h.sum == pytest.approx(5.5565)
+
+
+def test_histogram_rejects_empty_bounds():
+    with pytest.raises(ValueError):
+        Histogram(bounds=())
+
+
+def test_registry_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("c_total", kind="x").inc(3)
+    reg.gauge("g_depth").set(7)
+    reg.histogram("h_lat", buckets=(1.0, 2.0), backend="jax").observe(1.5)
+    snap = reg.snapshot()
+    assert snap["c_total"]["type"] == "counter"
+    assert snap["c_total"]["series"]['{kind="x"}'] == 3
+    assert snap["g_depth"]["series"]["{}"] == 7
+    hist = snap["h_lat"]["series"]['{backend="jax"}']
+    assert hist["buckets"] == {"1.0": 0, "2.0": 1}
+    assert hist["overflow"] == 0
+    assert hist["count"] == 1 and hist["sum"] == pytest.approx(1.5)
+
+
+def test_registry_type_stability():
+    reg = MetricsRegistry()
+    reg.counter("m")
+    with pytest.raises(ValueError):
+        reg.gauge("m")
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("waffle_x_total", backend="jax").inc(2)
+    reg.gauge("waffle_depth").set(4)
+    h = reg.histogram("waffle_lat_seconds", buckets=(0.1, 1.0), op="push")
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render_prometheus()
+    lines = text.strip().splitlines()
+    assert "# TYPE waffle_x_total counter" in lines
+    assert 'waffle_x_total{backend="jax"} 2.0' in lines
+    assert "waffle_depth 4.0" in lines
+    assert "# TYPE waffle_lat_seconds histogram" in lines
+    # cumulative le buckets with the +Inf total last
+    assert 'waffle_lat_seconds_bucket{op="push",le="0.1"} 1' in lines
+    assert 'waffle_lat_seconds_bucket{op="push",le="1.0"} 2' in lines
+    assert 'waffle_lat_seconds_bucket{op="push",le="+Inf"} 3' in lines
+    assert 'waffle_lat_seconds_count{op="push"} 3' in lines
+    assert any(
+        line.startswith('waffle_lat_seconds_sum{op="push"}') for line in lines
+    )
+
+
+# ------------------------------------------------------------- event log
+
+
+def test_event_log_saturation_counts_drops(monkeypatch):
+    events.clear_events()
+    monkeypatch.setattr(events, "_MAX_EVENTS", 3)
+    try:
+        for i in range(6):
+            events.record("test_event", i=i)
+        evs = events.get_events()
+        # cap=3: three stored events, then the marker rides along as the
+        # one out-of-cap entry counting every further drop
+        assert len(evs) == 4
+        assert evs[-1]["kind"] == "event_log_saturated"
+        assert evs[-1]["dropped"] == 3
+        summary = events.summarize_events()
+        assert summary == {"test_event": 3, "event_log_saturated": 1}
+    finally:
+        events.clear_events()
+
+
+def test_event_log_feeds_metrics_registry(obs_on):
+    events.clear_events()
+    try:
+        events.record("unit_test_kind")
+        events.record("unit_test_kind")
+        snap = obs_metrics.registry().snapshot()
+        series = snap["waffle_runtime_events_total"]["series"]
+        assert series['{kind="unit_test_kind"}'] == 2
+    finally:
+        events.clear_events()
+
+
+# ------------------------------------------------- dispatch instrumentation
+
+
+def test_construct_backend_plain_when_disabled():
+    from waffle_con_tpu.ops.scorer import PythonScorer
+
+    scorer = construct_backend(list(SINGLE_READS), _cfg(), "python")
+    assert isinstance(scorer, PythonScorer)
+
+
+def test_timed_scorer_records_latency_histograms(obs_on):
+    scorer = construct_backend(list(SINGLE_READS), _cfg(), "python")
+    assert isinstance(scorer, TimedScorer)
+    # feature-test transparency: the python oracle has no run kernels
+    assert getattr(scorer, "run_extend", None) is None
+    h = scorer.root(np.ones(len(SINGLE_READS), dtype=bool))
+    scorer.push(h, b"A")
+    scorer.stats(h, b"A")
+    snap = obs_metrics.registry().snapshot()
+    latency = snap["waffle_dispatch_latency_seconds"]["series"]
+    key_push = '{backend="python",op="push"}'
+    assert latency[key_push]["count"] == 1
+    assert latency['{backend="python",op="stats"}']["count"] == 1
+    totals = snap["waffle_dispatch_total"]["series"]
+    assert totals[key_push] == 1
+
+
+def test_timed_scorer_counters_stay_live(obs_on):
+    from waffle_con_tpu.ops.scorer import PythonScorer
+
+    scorer = maybe_instrument(
+        PythonScorer(list(SINGLE_READS), _cfg()), "python"
+    )
+    assert isinstance(scorer, TimedScorer)
+    # the supervisor adopts counters by plain assignment; the proxy must
+    # forward BOTH directions to the wrapped backend
+    shared = {"adopted": 1}
+    scorer.counters = shared
+    assert scorer._base.counters is shared
+    h = scorer.root(np.ones(len(SINGLE_READS), dtype=bool))
+    scorer.push(h, b"A")
+    assert shared["push_calls"] == 1  # backend increments land in shared
+
+
+def test_supervisor_demotion_lands_in_metrics(obs_on, faults):
+    faults.add("timeout", backend="jax", at=3, count=None)
+    faults.add("timeout", backend="jax", at=4, count=None)
+    cfg = _cfg(
+        backend_chain=("python",),
+        dispatch_retries=1,
+        breaker_threshold=2,
+        retry_backoff_s=0.0,
+    )
+    engine = ConsensusDWFA(cfg)
+    for r in SINGLE_READS:
+        engine.add_sequence(r)
+    results = engine.consensus()
+    assert results[0].sequence == b"ACGTACGT"
+    assert events.get_events("backend_demoted")  # the fault really fired
+    snap = obs_metrics.registry().snapshot()
+    demotions = snap["waffle_backend_demotions_total"]["series"]
+    key = '{from_backend="jax",to_backend="python"}'
+    assert demotions[key] == 1
+    failures = snap["waffle_dispatch_failures_total"]["series"]
+    assert sum(failures.values()) >= 2
+    # the demoted search's report names the backend that finished it
+    assert engine.last_search_report.backend == "python"
+
+
+# --------------------------------------------------------- search reports
+
+
+def test_search_report_from_single_engine(obs_on):
+    engine = ConsensusDWFA(_cfg(backend="python"))
+    for r in SINGLE_READS:
+        engine.add_sequence(r)
+    results = engine.consensus()
+    rep = engine.last_search_report
+    assert isinstance(rep, SearchReport)
+    assert rep.engine == "single" and rep.backend == "python"
+    assert rep.nodes_explored > 0 and rep.dispatch_total > 0
+    assert rep.n_results == len(results)
+    assert rep.consensus_len == len(results[0].sequence)
+    assert rep.wall_s > 0
+    d = rep.to_dict()
+    assert d["engine"] == "single"
+    assert "dispatch" in d["time_breakdown"]  # spans were recording
+    assert rep.summary_line().startswith("search summary: engine=single")
+    # engine searches also bump the registry-side search metrics
+    snap = obs_metrics.registry().snapshot()
+    assert snap["waffle_searches_total"]["series"]['{engine="single"}'] == 1
+
+
+def test_search_report_dual_peak_queue(obs_on):
+    engine = DualConsensusDWFA(_cfg(backend="python"))
+    for r in (b"ACGTACGT", b"ACGTACGT", b"ACTTACGT", b"ACTTACGT"):
+        engine.add_sequence(r)
+    engine.consensus()
+    rep = engine.last_search_report
+    assert rep.engine == "dual"
+    assert rep.peak_queue_size > 0  # the satellite: dual now tracks it
+    assert engine.last_search_stats["peak_queue_size"] == rep.peak_queue_size
+
+
+def test_search_report_without_obs_enabled():
+    # reports are built unconditionally (cheap); only spans/metrics gate
+    engine = ConsensusDWFA(_cfg(backend="python"))
+    for r in SINGLE_READS:
+        engine.add_sequence(r)
+    engine.consensus()
+    rep = engine.last_search_report
+    assert rep.nodes_explored > 0
+    assert rep.time_breakdown == {}  # no tracer -> no breakdown

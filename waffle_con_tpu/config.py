@@ -142,6 +142,9 @@ class CdwfaConfig:
     #: Watchdog strict mode: raise ``WatchdogError`` instead of warning
     #: when the dispatch budget is exceeded.  Framework extension.
     watchdog_strict: bool = False
+    #: Log each search's one-line summary (the ``SearchReport``
+    #: ``summary_line``) at INFO instead of DEBUG.  Framework extension.
+    log_search_summary: bool = False
 
     def __post_init__(self) -> None:
         if self.wildcard is not None and not 0 <= self.wildcard <= 255:

@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
     WavefrontScorer,
@@ -394,8 +396,15 @@ class ConsensusDWFA:
         """Run the least-cost-first search and return every tied-best
         consensus, lexicographically sorted.
 
-        Search skeleton parity: ``/root/reference/src/consensus.rs:139-351``.
+        Wraps :meth:`_consensus_impl` in a ``search`` tracer span and
+        publishes the structured :class:`SearchReport` as
+        ``self.last_search_report`` (one-line summary logged at INFO
+        when ``config.log_search_summary`` is set, else DEBUG).
         """
+        return _reported_search(self, "single", self._consensus_impl)
+
+    def _consensus_impl(self) -> List[Consensus]:
+        """Search skeleton parity: ``/root/reference/src/consensus.rs:139-351``."""
         cfg = self.config
         cost = cfg.consensus_cost
         maximum_error = math.inf
@@ -464,6 +473,10 @@ class ConsensusDWFA:
                     "best_cost=%d", pops, len(pqueue), farthest_consensus,
                     -priority[0],
                 )
+                if obs_metrics.metrics_enabled():
+                    obs_metrics.registry().gauge(
+                        "waffle_search_queue_depth", engine="single"
+                    ).set(len(pqueue))
             top_cost = -priority[0]
             top_len = len(node.consensus)
             tracker.remove(top_len)
@@ -734,15 +747,15 @@ class ConsensusDWFA:
         check_invariant(len(tracker) == 0, "tracker drained at search end")
 
         results.sort(key=lambda c: c.sequence)
-        logger.debug("nodes_explored: %d", nodes_explored)
-        logger.debug("nodes_ignored: %d", nodes_ignored)
-        logger.debug("peak_queue_size: %d", peak_queue_size)
-        #: search-shape observability for bench.py / profiling
+        #: search-shape observability for bench.py / profiling; the
+        #: public ``consensus()`` wrapper turns this into a SearchReport
         self.last_search_stats = {
             "nodes_explored": nodes_explored,
             "nodes_ignored": nodes_ignored,
             "peak_queue_size": peak_queue_size,
             "scorer_counters": dict(getattr(scorer, "counters", {})),
+            "backend": getattr(scorer, "timed_backend", None)
+            or getattr(scorer, "backend", None) or cfg.backend,
         }
         from waffle_con_tpu.runtime.watchdog import enforce_dispatch_budget
 

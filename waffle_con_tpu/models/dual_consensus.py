@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.models.consensus import (
     PROGRESS_LOG_INTERVAL,
     RUN_SIM_CAP,
@@ -362,9 +364,17 @@ class DualConsensusDWFA:
 
     def consensus(self) -> List[DualConsensus]:
         """Run the search; returns every tied-best result (sorted), or a
-        single empty-consensus fallback when no candidate survives
-        (parity skeleton: ``/root/reference/src/dual_consensus.rs:240-787``).
+        single empty-consensus fallback when no candidate survives.
+
+        Wraps :meth:`_consensus_impl` in a ``search`` tracer span and
+        publishes the structured :class:`SearchReport` as
+        ``self.last_search_report`` (one-line summary logged at INFO
+        when ``config.log_search_summary`` is set, else DEBUG).
         """
+        return _reported_search(self, "dual", self._consensus_impl)
+
+    def _consensus_impl(self) -> List[DualConsensus]:
+        """Parity skeleton: ``/root/reference/src/dual_consensus.rs:240-787``."""
         cfg = self.config
         cost = cfg.consensus_cost
         n_seqs = len(self.sequences)
@@ -375,6 +385,7 @@ class DualConsensusDWFA:
         dual_last_constraint = 0
         nodes_explored = 0
         nodes_ignored = 0
+        peak_queue_size = 0
 
         offsets = shift_offsets(self.offsets, cfg.auto_shift_offsets)
         logger.debug("Offsets: %s", offsets)
@@ -453,6 +464,7 @@ class DualConsensusDWFA:
 
         pops = 0
         while not pqueue.is_empty():
+            peak_queue_size = max(peak_queue_size, len(pqueue))
             while (
                 len(single_tracker) > cfg.max_queue_size
                 or single_last_constraint >= cfg.max_nodes_wo_constraint
@@ -474,6 +486,10 @@ class DualConsensusDWFA:
                     "best_cost=%d", pops, len(pqueue), farthest_single,
                     farthest_dual, -priority[0],
                 )
+                if obs_metrics.metrics_enabled():
+                    obs_metrics.registry().gauge(
+                        "waffle_search_queue_depth", engine="dual"
+                    ).set(len(pqueue))
             top_cost = -priority[0]
             top_len = node.max_consensus_length()
 
@@ -916,17 +932,19 @@ class DualConsensusDWFA:
                 )
             )
 
-        logger.debug("nodes_explored: %d", nodes_explored)
-        logger.debug("nodes_ignored: %d", nodes_ignored)
-        #: search-shape observability for bench.py / profiling
+        #: search-shape observability for bench.py / profiling; the
+        #: public ``consensus()`` wrapper turns this into a SearchReport
         counters_after = dict(getattr(scorer, "counters", {}))
         self.last_search_stats = {
             "nodes_explored": nodes_explored,
             "nodes_ignored": nodes_ignored,
+            "peak_queue_size": peak_queue_size,
             "scorer_counters": {
                 k: v - counters_before.get(k, 0)
                 for k, v in counters_after.items()
             },
+            "backend": getattr(scorer, "timed_backend", None)
+            or getattr(scorer, "backend", None) or cfg.backend,
         }
         from waffle_con_tpu.runtime.watchdog import enforce_dispatch_budget
 

@@ -22,6 +22,8 @@ from waffle_con_tpu.models.consensus import (
     check_invariant,
 )
 from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.ops.scorer import SubsetScorer, make_scorer
 
 logger = logging.getLogger(__name__)
@@ -112,6 +114,12 @@ class PriorityConsensusDWFA:
     # ------------------------------------------------------------------
 
     def consensus(self) -> PriorityConsensus:
+        """Wraps :meth:`_consensus_impl` in a ``search`` tracer span and
+        publishes the aggregated :class:`SearchReport` (summed over the
+        inner dual-engine group solves) as ``self.last_search_report``."""
+        return _reported_search(self, "priority", self._consensus_impl)
+
+    def _consensus_impl(self) -> PriorityConsensus:
         max_split_level = len(self.sequences[0])
         to_split: List[List[bool]] = []
         split_levels: List[int] = []
@@ -137,6 +145,10 @@ class PriorityConsensusDWFA:
         level_scorers: dict = {}
         merged_counters: dict = {}
         scorer_constructions = 0
+        total_explored = 0
+        total_ignored = 0
+        peak_queue_size = 0
+        last_backend = None
         share_scorer = self.config.backend == "jax"
         groups_solved = 0
         while to_split:
@@ -150,6 +162,10 @@ class PriorityConsensusDWFA:
                     "level=%d", groups_solved, len(to_split),
                     current_split_level,
                 )
+                if obs_metrics.metrics_enabled():
+                    obs_metrics.registry().gauge(
+                        "waffle_search_queue_depth", engine="priority"
+                    ).set(len(to_split))
 
             injected = None
             if share_scorer:
@@ -179,8 +195,15 @@ class PriorityConsensusDWFA:
                     )
 
             dc_result = dc_dwfa.consensus()
-            for k, v in dc_dwfa.last_search_stats["scorer_counters"].items():
+            inner_stats = dc_dwfa.last_search_stats
+            for k, v in inner_stats["scorer_counters"].items():
                 merged_counters[k] = merged_counters.get(k, 0) + v
+            total_explored += inner_stats.get("nodes_explored", 0)
+            total_ignored += inner_stats.get("nodes_ignored", 0)
+            peak_queue_size = max(
+                peak_queue_size, inner_stats.get("peak_queue_size", 0)
+            )
+            last_backend = inner_stats.get("backend", last_backend)
             if len(dc_result) > 1:
                 logger.debug(
                     "Multiple dual consensuses detected, arbitrarily selecting "
@@ -230,10 +253,16 @@ class PriorityConsensusDWFA:
 
         #: aggregated per-group scorer-counter deltas (bench.py /
         #: profiling observability); scorer_constructions is the
-        #: per-consensus() ctor count the sharing exists to minimize
+        #: per-consensus() ctor count the sharing exists to minimize;
+        #: search-shape numbers are summed (peak: max) over the inner
+        #: dual-engine group solves
         self.last_search_stats = {
             "scorer_counters": merged_counters,
             "scorer_constructions": scorer_constructions,
+            "nodes_explored": total_explored,
+            "nodes_ignored": total_ignored,
+            "peak_queue_size": peak_queue_size,
+            "backend": last_backend or self.config.backend,
         }
         from waffle_con_tpu.runtime.watchdog import enforce_dispatch_budget
 

@@ -1,0 +1,50 @@
+"""Observability subsystem: tracing, metrics, and search reports.
+
+Three cooperating pieces, all **off by default** and near-zero-cost when
+disabled:
+
+* :mod:`~waffle_con_tpu.obs.trace` — span-based host tracer
+  (search -> queue-pop batch -> dispatch -> device-sync) exporting
+  Chrome trace-event JSON (Perfetto-viewable), with an optional
+  ``jax.profiler.TraceAnnotation`` bridge.  Enable: ``WAFFLE_TRACE=1``
+  (or ``=<path>`` to auto-write at exit), or programmatically.
+* :mod:`~waffle_con_tpu.obs.metrics` — process-wide registry of
+  counters, gauges, and histograms (per-backend dispatch latency, queue
+  depth, branches-per-dispatch, handle-arena occupancy, supervisor
+  retry/demotion counts) with JSON and Prometheus-text exposition.
+  Enable: ``WAFFLE_METRICS=1`` or programmatically.
+* :mod:`~waffle_con_tpu.obs.report` — :class:`SearchReport`, the
+  structured per-search summary every engine stores as
+  ``last_search_report`` and ``bench.py`` embeds in evidence JSON.
+
+The runtime event log (:mod:`waffle_con_tpu.runtime.events`) is one
+sink of this pipeline: every recorded event also bumps the
+``waffle_runtime_events_total`` counter when metrics are on.
+"""
+
+from waffle_con_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_COUNT_BUCKETS,
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    enable_metrics,
+    metrics_enabled,
+    registry,
+    reset_metrics_enabled,
+)
+from waffle_con_tpu.obs.report import SearchReport  # noqa: F401
+from waffle_con_tpu.obs.trace import (  # noqa: F401
+    NULL_SPAN,
+    Tracer,
+    get_tracer,
+    span,
+    tracing_enabled,
+)
+
+
+def obs_enabled() -> bool:
+    """Whether any observability pipeline is recording (the gate for
+    installing dispatch instrumentation)."""
+    return metrics_enabled() or tracing_enabled()

@@ -1,0 +1,158 @@
+"""Dispatch instrumentation: a transparent latency-recording scorer proxy.
+
+:class:`TimedScorer` wraps a concrete backend scorer and times every
+blocking dispatch, recording:
+
+* ``waffle_dispatch_latency_seconds`` histogram per ``(backend, op)`` —
+  the quantity the WFA-on-PIM / gpuPairHMM ports credit for finding that
+  launch + transfer overhead, not the wavefront math, dominated;
+* ``waffle_dispatch_total`` counter per ``(backend, op)``;
+* ``waffle_dispatch_branches`` histogram per ``(backend, op)`` for the
+  fused multi-branch dispatches (branches-per-dispatch is the batching
+  win the ROADMAP's sharding work must not regress);
+* ``waffle_handle_arena_live`` / ``waffle_handle_arena_capacity``
+  gauges, sampled every few dispatches from the backend's
+  ``live_handles()``;
+
+and opens a ``dispatch:<op>`` tracer span (category ``dispatch``) so
+host dispatches nest inside the engines' ``search`` spans in the Chrome
+trace.
+
+The proxy is only installed when observability is active (see
+``construct_backend`` in :mod:`waffle_con_tpu.ops.scorer`); a disabled
+run never pays for it.  It is deliberately transparent to the engines'
+capability feature-tests: attribute access falls through to the wrapped
+backend, so ``getattr(scorer, "run_extend", None)`` is ``None`` exactly
+when the backend lacks the kernel.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import trace as obs_trace
+
+#: dispatch method -> short op label (the same vocabulary as the scorer
+#: counter keys and the supervisor's event ``op`` field)
+TIMED_OPS: Dict[str, str] = {
+    "root": "root",
+    "push": "push",
+    "push_many": "push",
+    "stats": "stats",
+    "clone": "clone",
+    "clone_many": "clone",
+    "clone_push_many": "clone_push",
+    "activate": "activate",
+    "deactivate": "activate",
+    "deactivate_many": "activate",
+    "finalized_eds": "finalize",
+    "best_activation_offset": "offset_scan",
+    "run_extend": "run",
+    "run_extend_dual": "run_dual",
+    "run_arena": "arena",
+}
+
+#: ops whose first positional argument is a spec list (fused dispatches)
+_BATCHED_OPS = frozenset(
+    {"push_many", "clone_many", "clone_push_many", "deactivate_many"}
+)
+
+#: sample the handle-arena occupancy gauge every this many dispatches
+_GAUGE_SAMPLE_EVERY = 16
+
+
+class TimedScorer:
+    """Latency/trace-recording proxy over a concrete backend scorer."""
+
+    def __init__(self, base, backend: str) -> None:
+        self._base = base
+        self._backend = backend
+        self._calls_since_gauge = 0
+
+    # ``counters`` must stay a live view of the backend's dict in BOTH
+    # directions: the supervisor swaps in a shared dict via plain
+    # attribute assignment (``scorer.counters = ...``) and the backend's
+    # own increments must land in whatever dict is current.
+    @property
+    def counters(self):
+        return self._base.counters
+
+    @counters.setter
+    def counters(self, value):
+        self._base.counters = value
+
+    @property
+    def timed_backend(self) -> str:
+        """The backend label this proxy records under."""
+        return self._backend
+
+    def _sample_arena_gauge(self) -> None:
+        live_handles = getattr(self._base, "live_handles", None)
+        if live_handles is None:
+            return
+        live, capacity = live_handles()
+        reg = obs_metrics.registry()
+        reg.gauge("waffle_handle_arena_live", backend=self._backend).set(live)
+        if capacity is not None:
+            reg.gauge(
+                "waffle_handle_arena_capacity", backend=self._backend
+            ).set(capacity)
+
+    def _wrap(self, name: str, op: str, fn):
+        backend = self._backend
+        batched = name in _BATCHED_OPS
+        span = obs_trace.span
+
+        def timed(*args, **kwargs):
+            metrics_on = obs_metrics.metrics_enabled()
+            with span(f"dispatch:{op}", "dispatch", backend=backend):
+                t0 = time.perf_counter()
+                try:
+                    return fn(*args, **kwargs)
+                finally:
+                    if metrics_on:
+                        dt = time.perf_counter() - t0
+                        reg = obs_metrics.registry()
+                        reg.histogram(
+                            "waffle_dispatch_latency_seconds",
+                            backend=backend, op=op,
+                        ).observe(dt)
+                        reg.counter(
+                            "waffle_dispatch_total", backend=backend, op=op
+                        ).inc()
+                        if batched and args:
+                            reg.histogram(
+                                "waffle_dispatch_branches",
+                                buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+                                backend=backend, op=op,
+                            ).observe(len(args[0]))
+                        self._calls_since_gauge += 1
+                        if self._calls_since_gauge >= _GAUGE_SAMPLE_EVERY:
+                            self._calls_since_gauge = 0
+                            self._sample_arena_gauge()
+
+        timed.__name__ = name
+        return timed
+
+    def __getattr__(self, name: str):
+        # normal lookup failed: delegate to the backend, wrapping timed
+        # dispatch methods once and caching the wrapper on the instance
+        # (instance-dict hits skip __getattr__ on every later access)
+        base = self.__dict__["_base"]
+        attr = getattr(base, name)
+        op = TIMED_OPS.get(name)
+        if op is None or not callable(attr):
+            return attr
+        wrapped = self._wrap(name, op, attr)
+        self.__dict__[name] = wrapped
+        return wrapped
+
+
+def maybe_instrument(scorer, backend: str):
+    """Wrap ``scorer`` in a :class:`TimedScorer` when observability is
+    active; return it unchanged otherwise."""
+    if obs_metrics.metrics_enabled() or obs_trace.tracing_enabled():
+        return TimedScorer(scorer, backend)
+    return scorer

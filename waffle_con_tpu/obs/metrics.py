@@ -1,0 +1,276 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The consensus runtime's hot loop is one blocking scorer dispatch per
+candidate extension; on tunneled device platforms wall time is
+``dispatches x latency``, so the registry's first-class citizens are the
+per-backend dispatch wall-clock latency **histograms** (recorded by
+:class:`~waffle_con_tpu.obs.instrument.TimedScorer`), alongside queue
+depth, branches-per-dispatch, handle-arena occupancy, and the
+retry/demotion counters fed from the PR-1 supervisor via
+:mod:`waffle_con_tpu.runtime.events` (the event log is one sink of this
+pipeline, the registry is another).
+
+Exposition: :meth:`MetricsRegistry.snapshot` (JSON-ready dict, embedded
+in ``bench.py`` evidence) and :meth:`MetricsRegistry.render_prometheus`
+(Prometheus text format 0.0.4).
+
+Overhead contract: everything here is **off by default**.  Callers gate
+instrumentation on :func:`metrics_enabled` (``WAFFLE_METRICS=1`` or
+:func:`enable_metrics`); with metrics off, no instrument objects are
+created and the engines' per-search cost is a handful of boolean checks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+#: default latency buckets (seconds): spans the observed dispatch range
+#: from sub-100us fused XLA:CPU calls to multi-second tunneled TPU
+#: round-trips, roughly x2.5 per step like Prometheus' defaults
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+#: default buckets for small-count histograms (branches per dispatch)
+DEFAULT_COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class Counter:
+    """Monotonically increasing counter."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    """Point-in-time value (queue depth, arena occupancy)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus semantics.
+
+    ``bounds`` are the inclusive upper edges of the finite buckets; one
+    implicit ``+Inf`` bucket catches the overflow.  ``counts[i]`` is the
+    NON-cumulative count of observations with
+    ``bounds[i-1] < v <= bounds[i]`` (Prometheus exposition cumulates at
+    render time).
+    """
+
+    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
+
+    def __init__(self, bounds: Iterable[float]) -> None:
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def _bucket_index(self, value: float) -> int:
+        # linear scan: bucket lists are short (<=20) and the scan is
+        # branch-predictable; bisect costs more in call overhead
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                return i
+        return len(self.bounds)
+
+    def observe(self, value: float) -> None:
+        i = self._bucket_index(value)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def cumulative(self) -> list:
+        """Cumulative counts per bound (Prometheus ``le`` semantics),
+        with the ``+Inf`` total last."""
+        out = []
+        running = 0
+        for c in self.counts:
+            running += c
+            out.append(running)
+        return out
+
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _format_labels(key: _LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric store with labelled children.
+
+    One metric name maps to a family; each distinct label set is its own
+    child instrument.  Families are type-stable: registering the same
+    name as a different type raises.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: name -> (kind, {label_key: instrument}, histogram bounds)
+        self._families: Dict[str, Tuple[str, Dict[_LabelKey, object], Optional[tuple]]] = {}
+
+    def _child(self, kind: str, name: str, labels: Dict[str, str],
+               bounds: Optional[Iterable[float]] = None):
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = (kind, {}, tuple(bounds) if bounds else None)
+                self._families[name] = fam
+            elif fam[0] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam[0]}"
+                )
+            child = fam[1].get(key)
+            if child is None:
+                if kind == "counter":
+                    child = Counter()
+                elif kind == "gauge":
+                    child = Gauge()
+                else:
+                    child = Histogram(fam[2] or DEFAULT_LATENCY_BUCKETS)
+                fam[1][key] = child
+            return child
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._child("counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._child("gauge", name, labels)
+
+    def histogram(
+        self, name: str, buckets: Optional[Iterable[float]] = None, **labels
+    ) -> Histogram:
+        return self._child("histogram", name, labels, bounds=buckets)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._families.clear()
+
+    # -- exposition ----------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """JSON-ready dump: ``{name: {"type": ..., "series": {labelstr:
+        value-or-histogram-dict}}}`` (the form ``bench.py`` embeds)."""
+        with self._lock:
+            families = {
+                name: (kind, dict(children))
+                for name, (kind, children, _b) in self._families.items()
+            }
+        out: Dict[str, Dict] = {}
+        for name, (kind, children) in sorted(families.items()):
+            series = {}
+            for key, child in sorted(children.items()):
+                label_str = _format_labels(key) or "{}"
+                if kind == "histogram":
+                    series[label_str] = {
+                        "buckets": {
+                            str(b): c
+                            for b, c in zip(child.bounds, child.counts)
+                        },
+                        "overflow": child.counts[-1],
+                        "sum": child.sum,
+                        "count": child.count,
+                    }
+                else:
+                    series[label_str] = child.value
+            out[name] = {"type": kind, "series": series}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            families = {
+                name: (kind, dict(children))
+                for name, (kind, children, _b) in self._families.items()
+            }
+        lines = []
+        for name, (kind, children) in sorted(families.items()):
+            lines.append(f"# TYPE {name} {kind}")
+            for key, child in sorted(children.items()):
+                if kind == "histogram":
+                    cumulative = child.cumulative()
+                    for b, c in zip(child.bounds, cumulative):
+                        le = _format_labels(key, f'le="{b}"')
+                        lines.append(f"{name}_bucket{le} {c}")
+                    le = _format_labels(key, 'le="+Inf"')
+                    lines.append(f"{name}_bucket{le} {cumulative[-1]}")
+                    lines.append(
+                        f"{name}_sum{_format_labels(key)} {child.sum}"
+                    )
+                    lines.append(
+                        f"{name}_count{_format_labels(key)} {child.count}"
+                    )
+                else:
+                    lines.append(
+                        f"{name}{_format_labels(key)} {child.value}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+#: the process-wide registry every component records into
+_REGISTRY = MetricsRegistry()
+#: programmatic override; None defers to the WAFFLE_METRICS env var
+_FORCED: Optional[bool] = None
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def metrics_enabled() -> bool:
+    """Whether instrumentation should record (``WAFFLE_METRICS`` env, or
+    a programmatic :func:`enable_metrics` override)."""
+    if _FORCED is not None:
+        return _FORCED
+    return os.environ.get("WAFFLE_METRICS", "") not in ("", "0")
+
+
+def enable_metrics(on: bool = True) -> None:
+    """Programmatic enable/disable (overrides the env var)."""
+    global _FORCED
+    _FORCED = bool(on)
+
+
+def reset_metrics_enabled() -> None:
+    """Drop the programmatic override; the env var rules again."""
+    global _FORCED
+    _FORCED = None

@@ -1,0 +1,205 @@
+"""Span-based host-side tracer with Chrome trace-event export.
+
+The search engines, the scorer instrumentation layer, and the JAX
+scorer's device-sync points open nested wall-clock **spans**
+(search -> queue-pop batch -> dispatch -> device-sync); finished spans
+are recorded as Chrome trace-event ``"ph": "X"`` complete events,
+exported with :meth:`Tracer.write_chrome_trace` and viewable in
+Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Optional ``jax.profiler`` bridge: with the bridge on, every host span
+also enters a :class:`jax.profiler.TraceAnnotation`, so when an XLA
+device trace is being captured (``jax.profiler.start_trace``) the host
+spans line up with the device timeline.  Caveat (README "Observability"):
+on CPU-only builds the annotations are inert unless a profiler trace is
+active, and annotation names land on the TraceMe timeline, not the XLA
+op timeline.
+
+Overhead contract: with tracing off (``WAFFLE_TRACE`` unset and no
+programmatic enable), :func:`span` returns a shared no-op context
+manager singleton — no allocation, no timestamps, no lock.
+
+``WAFFLE_TRACE`` values: ``1`` enables recording; any other non-empty,
+non-``0`` value is treated as an output path written at interpreter
+exit.  ``WAFFLE_TRACE_JAX=1`` additionally turns on the jax.profiler
+bridge.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared no-op span: the entire disabled-mode cost."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A live span; appends one Chrome complete event on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_start_ns", "_jax_ctx")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: Dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._jax_ctx = None
+
+    def __enter__(self):
+        ann = self._tracer._jax_annotation
+        if ann is not None:
+            self._jax_ctx = ann(self.name)
+            self._jax_ctx.__enter__()
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        end_ns = time.perf_counter_ns()
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*(exc or (None, None, None)))
+        self._tracer._finish(self, self._start_ns, end_ns)
+        return False
+
+
+class Tracer:
+    """Collects finished spans as Chrome trace events.
+
+    Also keeps per-category cumulative inclusive wall time
+    (:meth:`category_totals`), which the engines diff across a search to
+    build the :class:`~waffle_con_tpu.obs.report.SearchReport` time
+    breakdown.
+    """
+
+    def __init__(self) -> None:
+        self._forced: Optional[bool] = None
+        self._lock = threading.Lock()
+        self._events: List[Dict] = []
+        self._totals: Dict[str, float] = {}
+        self._t0_ns = time.perf_counter_ns()
+        self._jax_annotation = None  # set by enable_jax_bridge()
+        self._pid = os.getpid()
+
+    # -- enablement ----------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        if self._forced is not None:
+            return self._forced
+        return os.environ.get("WAFFLE_TRACE", "") not in ("", "0")
+
+    def enable(self, on: bool = True) -> None:
+        self._forced = bool(on)
+
+    def reset_enabled(self) -> None:
+        self._forced = None
+
+    def enable_jax_bridge(self, on: bool = True) -> bool:
+        """Wire spans to ``jax.profiler.TraceAnnotation``; returns
+        whether the bridge is active (False if jax is unavailable)."""
+        if not on:
+            self._jax_annotation = None
+            return False
+        try:
+            from jax.profiler import TraceAnnotation
+        except Exception:  # pragma: no cover - jax always present here
+            self._jax_annotation = None
+            return False
+        self._jax_annotation = TraceAnnotation
+        return True
+
+    # -- span lifecycle ------------------------------------------------
+
+    def span(self, name: str, cat: str = "host", **args):
+        """A context manager timing one nested region; the no-op
+        singleton when tracing is disabled."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def _finish(self, span: _Span, start_ns: int, end_ns: int) -> None:
+        event = {
+            "name": span.name,
+            "cat": span.cat,
+            "ph": "X",
+            "ts": (start_ns - self._t0_ns) / 1e3,
+            "dur": (end_ns - start_ns) / 1e3,
+            "pid": self._pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if span.args:
+            event["args"] = span.args
+        dt = (end_ns - start_ns) / 1e9
+        with self._lock:
+            self._events.append(event)
+            self._totals[span.cat] = self._totals.get(span.cat, 0.0) + dt
+
+    # -- export --------------------------------------------------------
+
+    def chrome_events(self) -> List[Dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def category_totals(self) -> Dict[str, float]:
+        """Cumulative inclusive seconds per span category."""
+        with self._lock:
+            return dict(self._totals)
+
+    def clear(self) -> None:
+        with self._lock:
+            del self._events[:]
+            self._totals.clear()
+
+    def write_chrome_trace(self, path: str, events: Optional[List[Dict]] = None) -> None:
+        """Write a Chrome trace-event JSON file (Perfetto-loadable)."""
+        payload = {
+            "traceEvents": self.chrome_events() if events is None else events,
+            "displayTimeUnit": "ms",
+        }
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, cat: str = "host", **args):
+    """Module-level shortcut for ``get_tracer().span(...)``."""
+    return _TRACER.span(name, cat, **args)
+
+
+def tracing_enabled() -> bool:
+    return _TRACER.enabled
+
+
+def _env_autosetup() -> None:
+    """Honor ``WAFFLE_TRACE=<path>`` (write at exit) and
+    ``WAFFLE_TRACE_JAX=1`` once at import."""
+    value = os.environ.get("WAFFLE_TRACE", "")
+    if value not in ("", "0", "1"):
+        atexit.register(lambda: _TRACER.write_chrome_trace(value))
+    if os.environ.get("WAFFLE_TRACE_JAX", "") not in ("", "0"):
+        _TRACER.enable_jax_bridge(True)
+
+
+_env_autosetup()

@@ -63,6 +63,7 @@ import numpy as np
 from jax import lax
 
 from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.obs.trace import span as _obs_span
 from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
 
 #: Numpy (not jnp) module constants: a ``jnp`` scalar here would (a) force
@@ -2370,6 +2371,11 @@ class JaxScorer(WavefrontScorer):
         """Current band half-width (diagnostics; grows geometrically)."""
         return self._E
 
+    def live_handles(self) -> Tuple[int, Optional[int]]:
+        """(live handle count, slot capacity) — the arena-occupancy pair
+        the obs gauges sample."""
+        return len(self._slot_of), self._B
+
     @property
     def _W(self) -> int:
         return 2 * self._E + 2
@@ -2532,7 +2538,8 @@ class JaxScorer(WavefrontScorer):
                 self._wc, self._et, self._A,
             )
             self._state = state
-            stats_np, ovf = jax.device_get((stats, overflow))
+            with _obs_span("device_get:push_many", "device-sync"):
+                stats_np, ovf = jax.device_get((stats, overflow))
             if bool(ovf):
                 self._grow_e()
                 continue
@@ -2587,7 +2594,8 @@ class JaxScorer(WavefrontScorer):
                 self._wc, self._et, self._A,
             )
             self._state = state
-            stats_np, ovf = jax.device_get((stats, overflow))
+            with _obs_span("device_get:clone_push_many", "device-sync"):
+                stats_np, ovf = jax.device_get((stats, overflow))
             if bool(ovf):
                 self._grow_e()
                 continue
@@ -2608,14 +2616,15 @@ class JaxScorer(WavefrontScorer):
             return self._stats_np(jax.device_get(cached[1]))
         self.counters["stats_calls"] += 1
         slot = self._slot_of[h]
-        return self._stats_np(
-            jax.device_get(
-                _j_stats(
-                    self._state, self._reads, self._rlen, np.int32(slot),
-                    self._A,
+        with _obs_span("device_get:stats", "device-sync"):
+            return self._stats_np(
+                jax.device_get(
+                    _j_stats(
+                        self._state, self._reads, self._rlen, np.int32(slot),
+                        self._A,
+                    )
                 )
             )
-        )
 
     def activate(
         self, h: int, read_index: int, offset: int, consensus: bytes
@@ -2817,17 +2826,18 @@ class JaxScorer(WavefrontScorer):
                 params, self._wc, self._et, self._A, uniform,
             )
         self._state = state
-        (steps, code, stats_np, cons_np, fin_np, fin_ovf,
-         rec_count) = jax.device_get(
-            (steps, code, stats, cons_row, fin_eds, fin_ovf, rec_count)
-        )
-        # the record buffers only ride home when something was absorbed
-        # (most run calls have none, and every fetched byte costs tunnel
-        # round-trip time)
-        if int(rec_count):
-            rec_steps_np, rec_fins_np = jax.device_get(
-                (rec_steps, rec_fins)
+        with _obs_span("device_get:run_extend", "device-sync"):
+            (steps, code, stats_np, cons_np, fin_np, fin_ovf,
+             rec_count) = jax.device_get(
+                (steps, code, stats, cons_row, fin_eds, fin_ovf, rec_count)
             )
+            # the record buffers only ride home when something was
+            # absorbed (most run calls have none, and every fetched byte
+            # costs tunnel round-trip time)
+            if int(rec_count):
+                rec_steps_np, rec_fins_np = jax.device_get(
+                    (rec_steps, rec_fins)
+                )
         steps = int(steps)
         code = int(code)
         self.counters["run_calls"] += 1
@@ -2957,16 +2967,17 @@ class JaxScorer(WavefrontScorer):
                 imb_tab, self._wc, self._et, self._A, uni1 and uni2,
             )
         self._state = state
-        (steps, code, stats1_np, stats2_np, act1_np, act2_np,
-         consa_np, consb_np, rec_count) = jax.device_get(
-            (steps, code, stats1, stats2, act1, act2, consa, consb,
-             rec_count)
-        )
-        if int(rec_count):
-            (rec_steps_np, rec_f1_np, rec_f2_np, rec_a1_np,
-             rec_a2_np) = jax.device_get(
-                (rec_steps, rec_f1, rec_f2, rec_a1, rec_a2)
+        with _obs_span("device_get:run_extend_dual", "device-sync"):
+            (steps, code, stats1_np, stats2_np, act1_np, act2_np,
+             consa_np, consb_np, rec_count) = jax.device_get(
+                (steps, code, stats1, stats2, act1, act2, consa, consb,
+                 rec_count)
             )
+            if int(rec_count):
+                (rec_steps_np, rec_f1_np, rec_f2_np, rec_a1_np,
+                 rec_a2_np) = jax.device_get(
+                    (rec_steps, rec_f1, rec_f2, rec_a1, rec_a2)
+                )
         steps = int(steps)
         code = int(code)
         self.counters["run_dual_calls"] += 1
@@ -3196,11 +3207,12 @@ class JaxScorer(WavefrontScorer):
             )
         )
         self._state = state
-        (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
-         cons_np, alive_np, cre_count, stop_diag) = jax.device_get(
-            (hist, nsteps, code, stop_node, steps, stats, act, cons,
-             alive, cre_count, stop_diag)
-        )
+        with _obs_span("device_get:run_arena", "device-sync"):
+            (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
+             cons_np, alive_np, cre_count, stop_diag) = jax.device_get(
+                (hist, nsteps, code, stop_node, steps, stats, act, cons,
+                 alive, cre_count, stop_diag)
+            )
         nsteps = int(nsteps)
         code = int(code)
         stop_node = int(stop_node)
@@ -3420,7 +3432,8 @@ class JaxScorer(WavefrontScorer):
         slot = self._slot_of[h]
         while True:
             eds, overflow = _j_finalize(self._state, np.int32(slot))
-            eds_np, ovf = jax.device_get((eds, overflow))
+            with _obs_span("device_get:finalized_eds", "device-sync"):
+                eds_np, ovf = jax.device_get((eds, overflow))
             if bool(ovf):
                 self._grow_e()
                 continue

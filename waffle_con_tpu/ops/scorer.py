@@ -234,6 +234,11 @@ class PythonScorer(WavefrontScorer):
     def free(self, h: int) -> None:
         self._branches.pop(h, None)
 
+    def live_handles(self) -> Tuple[int, Optional[int]]:
+        """(live handle count, slot capacity); the oracle's handle store
+        is an unbounded dict, so capacity is ``None``."""
+        return len(self._branches), None
+
     def _count(self, key: str) -> None:
         self.counters[key] = self.counters.get(key, 0) + 1
 
@@ -495,10 +500,16 @@ def construct_backend(
     reads: Sequence[bytes], config: CdwfaConfig, backend: str
 ) -> WavefrontScorer:
     """Instantiate one concrete backend scorer (the supervisor calls
-    this directly to build fallback scorers mid-search)."""
+    this directly to build fallback scorers mid-search).
+
+    This is the single choke point where every concrete scorer is born
+    (including supervisor-built mid-search fallbacks), so it is also
+    where dispatch instrumentation is installed: when observability is
+    active, the scorer is wrapped in an obs ``TimedScorer`` proxy that
+    records per-(backend, op) latency histograms and tracer spans."""
     if backend == "python":
-        return PythonScorer(reads, config)
-    if backend == "jax":
+        scorer = PythonScorer(reads, config)
+    elif backend == "jax":
         from waffle_con_tpu.ops.jax_scorer import JaxScorer
 
         scorer = JaxScorer(reads, config)
@@ -506,12 +517,15 @@ def construct_backend(
             from waffle_con_tpu.parallel import shard_for_config
 
             shard_for_config(scorer, config)
-        return scorer
-    if backend == "native":
+    elif backend == "native":
         from waffle_con_tpu.native import NativeScorer
 
-        return NativeScorer(reads, config)
-    raise ValueError(f"unknown backend {backend!r}")
+        scorer = NativeScorer(reads, config)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    from waffle_con_tpu.obs.instrument import maybe_instrument
+
+    return maybe_instrument(scorer, backend)
 
 
 def make_scorer(reads: Sequence[bytes], config: CdwfaConfig) -> WavefrontScorer:

@@ -24,13 +24,30 @@ _MAX_EVENTS = 10_000
 
 
 def record(kind: str, **details) -> Dict:
-    """Append an event and return it."""
+    """Append an event and return it.
+
+    Past the cap, events are counted rather than stored: the trailing
+    ``event_log_saturated`` marker's ``dropped`` field says exactly how
+    many events were discarded (previously they vanished silently).
+    The event log is also one sink of the obs pipeline — every recorded
+    event bumps ``waffle_runtime_events_total{kind=...}`` when metrics
+    are on, so dropped events still show up in the registry totals.
+    """
     event = {"kind": kind, **details}
     with _LOCK:
         if len(_EVENTS) < _MAX_EVENTS:
             _EVENTS.append(event)
-        elif _EVENTS[-1].get("kind") != "event_log_saturated":
-            _EVENTS.append({"kind": "event_log_saturated"})
+        elif _EVENTS[-1].get("kind") == "event_log_saturated":
+            _EVENTS[-1]["dropped"] += 1
+        else:
+            _EVENTS.append({"kind": "event_log_saturated", "dropped": 1})
+    # lazy import: obs must stay import-light and cycle-free from here
+    from waffle_con_tpu.obs import metrics as obs_metrics
+
+    if obs_metrics.metrics_enabled():
+        obs_metrics.registry().counter(
+            "waffle_runtime_events_total", kind=kind
+        ).inc()
     return event
 
 

@@ -48,10 +48,17 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
 from waffle_con_tpu.runtime import events, faults
 
 logger = logging.getLogger(__name__)
+
+
+def _metric_inc(name: str, **labels) -> None:
+    """Bump a supervisor counter when the metrics pipeline is on."""
+    if obs_metrics.metrics_enabled():
+        obs_metrics.registry().counter(name, **labels).inc()
 
 #: fallback order when ``config.backend_chain`` is not set: most
 #: capable first, the Python executable-specification oracle last
@@ -224,6 +231,10 @@ class BackendSupervisor(WavefrontScorer):
                 "backend_demoted", from_backend=old, to_backend=target,
                 handles=len(self._ledger), cause=repr(cause),
             )
+            _metric_inc(
+                "waffle_backend_demotions_total",
+                from_backend=old, to_backend=target,
+            )
             logger.warning(
                 "demoting backend %s -> %s (%d live handles migrated): %r",
                 old, target, len(self._ledger), cause,
@@ -271,6 +282,10 @@ class BackendSupervisor(WavefrontScorer):
         events.record(
             "backend_promoted", from_backend=old, to_backend=target,
             handles=len(self._ledger),
+        )
+        _metric_inc(
+            "waffle_backend_promotions_total",
+            from_backend=old, to_backend=target,
         )
         logger.warning(
             "re-promoted backend %s -> %s (%d live handles migrated)",
@@ -352,6 +367,10 @@ class BackendSupervisor(WavefrontScorer):
                     "dispatch_failed", backend=self.backend, op=op,
                     index=idx, attempt=attempts, error=repr(exc),
                 )
+                _metric_inc(
+                    "waffle_dispatch_failures_total",
+                    backend=self.backend, op=op,
+                )
                 logger.warning(
                     "dispatch %s failed on %s (attempt %d): %r",
                     op, self.backend, attempts, exc,
@@ -365,6 +384,10 @@ class BackendSupervisor(WavefrontScorer):
                     self._demote(exc)
                     attempts = 0
                     continue
+                _metric_inc(
+                    "waffle_dispatch_retries_total",
+                    backend=self.backend, op=op,
+                )
                 self._sleep_backoff(attempts)
                 if mutating and started:
                     # the failed call may have half-applied; rebuild the
