@@ -566,6 +566,91 @@ def bench_priority(num_reads, seq_len, error_rate, iters=5, trace_out=None):
     return out
 
 
+def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None):
+    """Serving-throughput mode: N concurrent north-star-shaped single
+    jobs through :class:`ConsensusService`, measuring jobs/s, mean batch
+    occupancy of the cross-job dispatcher, and p50/p95 per-job latency.
+
+    One job is run serially first (warms the XLA compile cache so the
+    timed window measures serving, not compilation) and its result
+    doubles as the parity reference for the served job with the same
+    seed."""
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.serve import ConsensusService, JobRequest, ServeConfig
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    min_count = max(2, num_reads // 4)
+    band = _band_seed(seq_len, error_rate)
+    cfg = (
+        CdwfaConfigBuilder()
+        .min_count(min_count)
+        .backend("jax")
+        .initial_band(band)
+        .build()
+    )
+    workloads = [
+        generate_test(4, seq_len, num_reads, error_rate, seed=i)[1]
+        for i in range(num_jobs)
+    ]
+
+    warm_start = time.perf_counter()
+    serial_reference = _make_engine("single", cfg, workloads[0]).consensus()
+    warm_time = time.perf_counter() - warm_start
+
+    tracer = _obs_setup(trace_out)
+    _obs_iter_begin(tracer)
+    svc = ConsensusService(
+        ServeConfig(
+            workers=min(num_jobs, 8),
+            queue_limit=max(8, 2 * num_jobs),
+            batch_window_s=0.005,
+            max_batch=8,
+        )
+    )
+    t0 = time.perf_counter()
+    handles = svc.submit_all(
+        [
+            JobRequest(kind="single", reads=tuple(reads), config=cfg)
+            for reads in workloads
+        ]
+    )
+    results = [h.result() for h in handles]
+    wall = time.perf_counter() - t0
+    stats = svc.stats()
+    svc.close()
+
+    latencies = sorted(h.latency_s for h in handles)
+    p50 = latencies[len(latencies) // 2]
+    p95 = latencies[min(len(latencies) - 1, int(len(latencies) * 0.95))]
+    reports = [
+        h.search_report.to_dict() for h in handles
+        if h.search_report is not None
+    ]
+    out = {
+        "metric": f"serve_{num_jobs}jobs_{num_reads}x{seq_len}_jobs_per_s",
+        "value": round(num_jobs / wall, 4),
+        "unit": "jobs/s",
+        "mode": "serve",
+        "jobs": num_jobs,
+        "jobs_per_s": round(num_jobs / wall, 4),
+        "wall_s": round(wall, 4),
+        "mean_batch_occupancy": round(
+            stats["dispatch"]["mean_batch_occupancy"], 4
+        ),
+        "p50_job_latency_s": round(p50, 4),
+        "p95_job_latency_s": round(p95, 4),
+        "num_reads": num_reads,
+        "seq_len": seq_len,
+        "warmup_s": round(warm_time, 4),
+        "parity": bool(results[0] == serial_reference),
+        "serve_stats": stats,
+        "runtime_events": _runtime_events(),
+    }
+    slowest = (wall, tracer.chrome_events()) if tracer is not None else (wall, None)
+    _obs_finish(out, tracer, trace_out, reports, slowest)
+    return out
+
+
 def _child_cmd(mode_args, platform):
     return [
         sys.executable,
@@ -820,6 +905,12 @@ def main() -> None:
         "iteration SearchReport in the evidence JSON",
     )
     parser.add_argument(
+        "--serve", type=int, default=None, metavar="N",
+        help="serving-throughput mode: N concurrent jobs through "
+        "ConsensusService; reports jobs/s, mean batch occupancy, and "
+        "p50/p95 job latency",
+    )
+    parser.add_argument(
         "--platform", choices=("auto", "cpu", "device"), default="auto"
     )
     # hidden: one in-process bench attempt / gate run (orchestrator children)
@@ -834,8 +925,25 @@ def main() -> None:
     # never touches jax in the parent (children carry --platform)
     if args.platform == "cpu" and (
         args._run or args._gate or args.grid or args.dual or args.priority
+        or args.serve
     ):
         _force_cpu_backend()
+
+    if args.serve:
+        from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
+        out = bench_serve(
+            args.serve,
+            args.reads or (16 if smoke else 64),
+            args.seq_len or (1000 if smoke else 2000),
+            0.01,
+            trace_out=args.trace_out,
+        )
+        out["device_platform"] = _current_platform()
+        print(json.dumps(out))
+        return
 
     if args._run:
         try:

@@ -56,4 +56,42 @@ print(
 )
 PY
 
+echo "== serve bench smoke (cross-job batching) =="
+SERVE_OUT="$(mktemp /tmp/waffle_ci_serve.XXXXXX.json)"
+trap 'rm -f "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT"' EXIT
+
+WAFFLE_METRICS=1 BENCH_SMOKE=1 \
+  python bench.py --serve 4 --platform cpu > "$SERVE_OUT"
+
+python - "$SERVE_OUT" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    evidence = json.loads(fh.read().strip().splitlines()[-1])
+assert evidence.get("mode") == "serve", f"not a serve line: {sorted(evidence)}"
+assert evidence["jobs"] == 4, evidence["jobs"]
+assert evidence["jobs_per_s"] > 0, evidence["jobs_per_s"]
+assert evidence["parity"] is True, "served result diverged from serial"
+assert 0 <= evidence["p50_job_latency_s"] <= evidence["p95_job_latency_s"], (
+    evidence["p50_job_latency_s"], evidence["p95_job_latency_s"],
+)
+dispatch = evidence["serve_stats"]["dispatch"]
+assert dispatch["coalesced_batches"] >= 1, dispatch
+assert evidence["mean_batch_occupancy"] > 1.0, evidence["mean_batch_occupancy"]
+jobs = evidence["serve_stats"]["jobs"]
+assert jobs["done"] == 4 and jobs["failed"] == 0, jobs
+serve_metrics = [
+    k for k in evidence.get("metrics", {}) if k.startswith("waffle_serve")
+]
+assert "waffle_serve_batch_occupancy" in serve_metrics, serve_metrics
+assert "waffle_serve_jobs_total" in serve_metrics, serve_metrics
+print(
+    f"ci serve smoke ok: {evidence['jobs_per_s']} jobs/s, "
+    f"occupancy={evidence['mean_batch_occupancy']}, "
+    f"p95={evidence['p95_job_latency_s']}s, "
+    f"{len(serve_metrics)} serve metric families"
+)
+PY
+
 echo "== ci.sh: all green =="

@@ -1,0 +1,327 @@
+"""Serve-layer contract tests.
+
+Core claim under test: N concurrent mixed-engine jobs through
+:class:`ConsensusService` return results **byte-identical** to serial
+execution of the same requests (golden fixtures included), while the
+cross-job :class:`BatchingDispatcher` actually coalesces (mean batch
+occupancy > 1 under concurrent load).  Plus the scheduling semantics:
+bounded queue rejects typed-and-fast when full, priorities pop first
+(FIFO within a class), deadlines and cancellation abort at dispatch
+boundaries, and fault-injected backend demotion works inside a served
+job exactly as it does serially.
+"""
+
+import time
+
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.serve import (
+    ConsensusService,
+    DeadlineExceeded,
+    JobCancelled,
+    JobRequest,
+    JobStatus,
+    ServeConfig,
+    ServiceClosed,
+    ServiceOverloaded,
+)
+from waffle_con_tpu.serve.service import _build_engine
+from waffle_con_tpu.utils.example_gen import generate_test
+from waffle_con_tpu.utils.fixtures import (
+    load_dual_fixture,
+    load_priority_fixture,
+)
+
+pytestmark = pytest.mark.serve
+
+DUAL_READS = (b"ACGTACGT", b"ACGTACGT", b"ACTTACGT", b"ACTTACGT")
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().backend("python")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _fixture_cfg():
+    return _cfg(wildcard=ord("*"))
+
+
+def _serial(request: JobRequest):
+    """The serial reference: same construction path as the service,
+    no decorator installed, run on the calling thread."""
+    return _build_engine(request).consensus()
+
+
+def _mixed_requests():
+    """Eight mixed-engine jobs: every golden fixture scenario plus
+    synthetic single/dual workloads."""
+    fcfg = _fixture_cfg()
+    requests = []
+    sequences, _ = load_dual_fixture("dual_001", True, fcfg.consensus_cost)
+    requests.append(
+        JobRequest(kind="dual", reads=tuple(sequences), config=fcfg)
+    )
+    for name, include in (
+        ("multi_exact_001", True),
+        ("multi_err_001", False),
+        ("multi_samesplit_001", True),
+        ("priority_001", True),
+    ):
+        chains, _ = load_priority_fixture(name, include, fcfg.consensus_cost)
+        requests.append(
+            JobRequest(
+                kind="priority",
+                reads=tuple(tuple(c) for c in chains),
+                config=fcfg,
+                tag=name,
+            )
+        )
+    scfg = _cfg(min_count=2)
+    for seed in (0, 1):
+        _, reads = generate_test(4, 160, 6, 0.02, seed=seed)
+        requests.append(
+            JobRequest(kind="single", reads=tuple(reads), config=scfg)
+        )
+    requests.append(
+        JobRequest(kind="dual", reads=DUAL_READS, config=_cfg(min_count=1))
+    )
+    return requests
+
+
+# ------------------------------------------------ parity (the tentpole)
+
+
+def test_concurrent_mixed_jobs_byte_identical_to_serial():
+    requests = _mixed_requests()
+    assert len(requests) >= 8
+    expected = [_serial(r) for r in requests]
+
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=0.02)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        results = [h.result(timeout=300) for h in handles]
+        stats = svc.stats()
+
+    for req, got, want in zip(requests, results, expected):
+        assert got == want, f"served {req.kind} job diverged from serial"
+    assert stats["jobs"]["done"] == len(requests)
+    assert stats["jobs"]["failed"] == 0
+
+    # the fixture scenarios also match their golden expectations
+    fcfg = _fixture_cfg()
+    _, dual_expected = load_dual_fixture("dual_001", True, fcfg.consensus_cost)
+    assert results[0] == [dual_expected]
+    for req, got in zip(requests[1:5], results[1:5]):
+        chains, want = load_priority_fixture(
+            req.tag, req.tag != "multi_err_001", fcfg.consensus_cost
+        )
+        assert got.sequence_indices == want.sequence_indices
+        assert [[c.sequence for c in chain] for chain in got.consensuses] == [
+            [c.sequence for c in chain] for chain in want.consensuses
+        ]
+
+
+def test_batch_occupancy_above_one_under_concurrent_load():
+    cfg = _cfg(min_count=2)
+    _, reads = generate_test(4, 150, 6, 0.02, seed=3)
+    expected = None
+    with ConsensusService(
+        ServeConfig(workers=8, batch_window_s=0.05, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(
+            [JobRequest(kind="single", reads=tuple(reads), config=cfg)
+             for _ in range(8)]
+        )
+        results = [h.result(timeout=300) for h in handles]
+        dispatch = svc.stats()["dispatch"]
+    expected = _serial(
+        JobRequest(kind="single", reads=tuple(reads), config=cfg)
+    )
+    assert all(r == expected for r in results)
+    # identical jobs share one shape bucket: with 8 workers and a
+    # generous window the dispatcher must actually coalesce
+    assert dispatch["coalesced_batches"] > 0
+    assert dispatch["mean_batch_occupancy"] > 1.0
+
+
+# ------------------------------------------------ admission / backpressure
+
+
+def test_full_queue_rejects_typed_not_blocking():
+    cfg = _cfg(min_count=1)
+    req = JobRequest(kind="dual", reads=DUAL_READS, config=cfg)
+    # workers parked: the queue fills deterministically
+    svc = ConsensusService(
+        ServeConfig(workers=2, queue_limit=2), autostart=False
+    )
+    h1 = svc.submit(req)
+    h2 = svc.submit(req)
+    t0 = time.monotonic()
+    with pytest.raises(ServiceOverloaded):
+        svc.submit(req)
+    assert time.monotonic() - t0 < 1.0, "rejection must not block"
+    assert svc.stats()["jobs"]["rejected"] == 1
+
+    svc.start()
+    assert h1.result(timeout=120) == h2.result(timeout=120)
+    svc.close()
+    with pytest.raises(ServiceClosed):
+        svc.submit(req)
+
+
+def test_priority_classes_fifo_within_class():
+    cfg = _cfg(min_count=1)
+    req = lambda prio: JobRequest(
+        kind="dual", reads=DUAL_READS, config=cfg, priority=prio
+    )
+    svc = ConsensusService(ServeConfig(workers=1), autostart=False)
+    low_a = svc.submit(req(0))
+    low_b = svc.submit(req(0))
+    high = svc.submit(req(5))
+    svc.start()
+    for h in (low_a, low_b, high):
+        h.result(timeout=120)
+    svc.close()
+    assert high.started_at < low_a.started_at < low_b.started_at
+
+
+# ------------------------------------------------ deadlines / cancellation
+
+
+def test_cancel_queued_job_finalizes_immediately():
+    cfg = _cfg(min_count=1)
+    req = JobRequest(kind="dual", reads=DUAL_READS, config=cfg)
+    svc = ConsensusService(ServeConfig(workers=1), autostart=False)
+    keep = svc.submit(req)
+    doomed = svc.submit(req)
+    assert doomed.cancel()
+    assert doomed.status is JobStatus.CANCELLED
+    with pytest.raises(JobCancelled):
+        doomed.result(timeout=0)
+    assert not doomed.cancel(), "second cancel reports already-terminal"
+    svc.start()
+    assert keep.result(timeout=120)
+    svc.close()
+    assert svc.stats()["jobs"]["cancelled"] == 1
+
+
+def test_cancel_mid_run_aborts_at_dispatch_boundary():
+    cfg = _cfg(min_count=2)
+    _, reads = generate_test(4, 1500, 12, 0.04, seed=2)  # ~seconds of work
+    with ConsensusService(ServeConfig(workers=1)) as svc:
+        h = svc.submit(
+            JobRequest(kind="single", reads=tuple(reads), config=cfg)
+        )
+        assert h.wait_running(30)
+        time.sleep(0.2)
+        assert h.cancel()
+        with pytest.raises(JobCancelled):
+            h.result(timeout=60)
+        assert h.status is JobStatus.CANCELLED
+
+
+def test_deadline_lapsed_in_queue_expires_at_pop():
+    cfg = _cfg(min_count=1)
+    svc = ConsensusService(ServeConfig(workers=1), autostart=False)
+    h = svc.submit(
+        JobRequest(
+            kind="dual", reads=DUAL_READS, config=cfg, deadline_s=0.01
+        )
+    )
+    time.sleep(0.05)
+    svc.start()
+    with pytest.raises(DeadlineExceeded):
+        h.result(timeout=60)
+    assert h.status is JobStatus.EXPIRED
+    svc.close()
+    assert svc.stats()["jobs"]["expired"] == 1
+    assert events.get_events("deadline_exceeded")
+
+
+def test_deadline_mid_run_expires_at_dispatch_boundary():
+    cfg = _cfg(min_count=2)
+    _, reads = generate_test(4, 1500, 12, 0.04, seed=2)
+    with ConsensusService(ServeConfig(workers=1)) as svc:
+        h = svc.submit(
+            JobRequest(
+                kind="single", reads=tuple(reads), config=cfg,
+                deadline_s=0.4,
+            )
+        )
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=60)
+        assert h.status is JobStatus.EXPIRED
+
+
+# ------------------------------------------------ fault tolerance composes
+
+
+@pytest.mark.faultinject
+def test_backend_demotion_inside_served_job(faults):
+    """A supervised job served concurrently still demotes jax -> python
+    mid-search on injected faults, byte-identical to the unfaulted run."""
+    def cfg(**kw):
+        b = CdwfaConfigBuilder().min_count(1).backend("jax")
+        for k, v in kw.items():
+            b = getattr(b, k)(v)
+        return b.build()
+
+    reads = (b"ACGTACGTACGT", b"ACGTACGTACGT", b"ACCTACGTACGT")
+    expected = _serial(JobRequest(kind="single", reads=reads, config=cfg()))
+
+    faults.add("timeout", backend="jax", at=3, count=None)
+    faults.add("timeout", backend="jax", at=4, count=None)
+    sup = cfg(
+        backend_chain=("python",), dispatch_retries=1,
+        breaker_threshold=2, retry_backoff_s=0.0,
+    )
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        h = svc.submit(JobRequest(kind="single", reads=reads, config=sup))
+        got = h.result(timeout=300)
+    demotions = events.get_events("backend_demoted")
+    assert [(d["from_backend"], d["to_backend"]) for d in demotions] == [
+        ("jax", "python")
+    ]
+    assert [(c.sequence, c.scores) for c in got] == [
+        (c.sequence, c.scores) for c in expected
+    ]
+
+
+# ------------------------------------------------ serve metrics
+
+
+def test_serve_metrics_emitted():
+    from waffle_con_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.enable_metrics(True)
+    obs_metrics.registry().reset()
+    try:
+        cfg = _cfg(min_count=2)
+        _, reads = generate_test(4, 120, 6, 0.02, seed=4)
+        with ConsensusService(
+            ServeConfig(workers=4, batch_window_s=0.05, queue_limit=2)
+        ) as svc:
+            handles = svc.submit_all(
+                [JobRequest(kind="single", reads=tuple(reads), config=cfg)
+                 for _ in range(2)]
+            )
+            for h in handles:
+                h.result(timeout=300)
+        snap = obs_metrics.registry().snapshot()
+    finally:
+        obs_metrics.registry().reset()
+        obs_metrics.reset_metrics_enabled()
+
+    assert "waffle_serve_queue_depth" in snap
+    jobs_total = snap["waffle_serve_jobs_total"]["series"]
+    assert sum(
+        v for k, v in jobs_total.items() if 'outcome="done"' in k
+    ) == 2
+    assert "waffle_serve_job_latency_seconds" in snap
+    occupancy = snap["waffle_serve_batch_occupancy"]["series"]
+    assert sum(s["count"] for s in occupancy.values()) > 0

@@ -23,6 +23,18 @@ Architecture (TPU-first, not a translation):
   generation, golden-fixture loaders.
 * ``native``   — C++ implementations of the kernels and engines (the fast
   CPU path and the benchmark baseline), bound via ctypes.
+* ``runtime``  — fault tolerance: the ``BackendSupervisor`` scorer proxy
+  (retry/backoff, mid-search backend demotion), deterministic fault
+  injection, dispatch-budget + deadline watchdog, process-wide event log.
+* ``obs``      — observability: span tracer (Chrome trace export), metrics
+  registry (Prometheus/JSON exposition), ``TimedScorer`` dispatch-latency
+  proxy, structured per-search reports.
+* ``serve``    — multi-tenant serving: ``ConsensusService`` worker pool
+  with a bounded reject-on-full admission queue, per-job deadlines /
+  cancellation / priorities, and cross-job dynamic batching of scorer
+  dispatches (``BatchingDispatcher`` + ``CoalescingScorer``) so N
+  concurrent jobs amortize device dispatch overhead while staying
+  byte-identical to serial runs.
 
 Reference layer map: see SURVEY.md §1; the public API parity targets the
 reference's six modules (``/root/reference/src/lib.rs:38-55``).
@@ -36,6 +48,12 @@ from waffle_con_tpu.models.priority_consensus import (
     PriorityConsensus,
     PriorityConsensusDWFA,
 )
+from waffle_con_tpu.serve import (
+    ConsensusService,
+    JobRequest,
+    ServeConfig,
+    ServiceOverloaded,
+)
 
 __version__ = "0.1.0"
 
@@ -45,9 +63,13 @@ __all__ = [
     "ConsensusCost",
     "Consensus",
     "ConsensusDWFA",
+    "ConsensusService",
     "DualConsensus",
     "DualConsensusDWFA",
+    "JobRequest",
     "MultiConsensus",
     "PriorityConsensus",
     "PriorityConsensusDWFA",
+    "ServeConfig",
+    "ServiceOverloaded",
 ]

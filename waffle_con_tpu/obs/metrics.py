@@ -51,6 +51,10 @@ class Counter:
         with self._lock:
             self.value += amount
 
+    def read(self) -> float:
+        with self._lock:
+            return self.value
+
 
 class Gauge:
     """Point-in-time value (queue depth, arena occupancy)."""
@@ -71,6 +75,10 @@ class Gauge:
 
     def dec(self, amount: float = 1.0) -> None:
         self.inc(-amount)
+
+    def read(self) -> float:
+        with self._lock:
+            return self.value
 
 
 class Histogram:
@@ -109,12 +117,21 @@ class Histogram:
             self.sum += value
             self.count += 1
 
+    def read(self) -> Tuple[list, float, int]:
+        """Consistent ``(counts, sum, count)`` triple taken under the
+        instrument lock — exposition must not see a half-applied
+        ``observe`` from a concurrently recording thread (the serve
+        layer records from many workers at once)."""
+        with self._lock:
+            return list(self.counts), self.sum, self.count
+
     def cumulative(self) -> list:
         """Cumulative counts per bound (Prometheus ``le`` semantics),
         with the ``+Inf`` total last."""
+        counts, _sum, _count = self.read()
         out = []
         running = 0
-        for c in self.counts:
+        for c in counts:
             running += c
             out.append(running)
         return out
@@ -201,17 +218,18 @@ class MetricsRegistry:
             for key, child in sorted(children.items()):
                 label_str = _format_labels(key) or "{}"
                 if kind == "histogram":
+                    counts, h_sum, h_count = child.read()
                     series[label_str] = {
                         "buckets": {
                             str(b): c
-                            for b, c in zip(child.bounds, child.counts)
+                            for b, c in zip(child.bounds, counts)
                         },
-                        "overflow": child.counts[-1],
-                        "sum": child.sum,
-                        "count": child.count,
+                        "overflow": counts[-1],
+                        "sum": h_sum,
+                        "count": h_count,
                     }
                 else:
-                    series[label_str] = child.value
+                    series[label_str] = child.read()
             out[name] = {"type": kind, "series": series}
         return out
 
@@ -227,21 +245,25 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} {kind}")
             for key, child in sorted(children.items()):
                 if kind == "histogram":
-                    cumulative = child.cumulative()
+                    counts, h_sum, h_count = child.read()
+                    cumulative, running = [], 0
+                    for c in counts:
+                        running += c
+                        cumulative.append(running)
                     for b, c in zip(child.bounds, cumulative):
                         le = _format_labels(key, f'le="{b}"')
                         lines.append(f"{name}_bucket{le} {c}")
                     le = _format_labels(key, 'le="+Inf"')
                     lines.append(f"{name}_bucket{le} {cumulative[-1]}")
                     lines.append(
-                        f"{name}_sum{_format_labels(key)} {child.sum}"
+                        f"{name}_sum{_format_labels(key)} {h_sum}"
                     )
                     lines.append(
-                        f"{name}_count{_format_labels(key)} {child.count}"
+                        f"{name}_count{_format_labels(key)} {h_count}"
                     )
                 else:
                     lines.append(
-                        f"{name}{_format_labels(key)} {child.value}"
+                        f"{name}{_format_labels(key)} {child.read()}"
                     )
         return "\n".join(lines) + "\n"
 

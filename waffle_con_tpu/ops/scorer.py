@@ -26,6 +26,7 @@ cf. ``/root/reference/src/consensus.rs:546-552``).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -528,11 +529,43 @@ def construct_backend(
     return maybe_instrument(scorer, backend)
 
 
+#: thread-local scorer decoration (see :func:`set_scorer_decorator`)
+_SCORER_HOOK = threading.local()
+
+
+def set_scorer_decorator(decorator):
+    """Install a *thread-local* decorator applied to every scorer that
+    :func:`make_scorer` builds on this thread; returns the previous
+    decorator so callers can restore it (``None`` = none installed).
+
+    This is the serve layer's injection point: a worker thread installs
+    ``lambda s: CoalescingScorer(s, dispatcher, job)`` around an
+    engine's ``consensus()`` call, and every scorer the engine
+    constructs — including the priority engine's per-level shared base
+    scorers — transparently routes its dispatches through the cross-job
+    batching dispatcher.  Thread-locality keeps concurrent jobs from
+    seeing each other's wrappers.  Note the decorator applies only in
+    :func:`make_scorer`, never in :func:`construct_backend`: fallback
+    scorers the supervisor builds mid-search live *inside* an already
+    routed dispatch and must not be re-routed.
+    """
+    previous = getattr(_SCORER_HOOK, "decorator", None)
+    _SCORER_HOOK.decorator = decorator
+    return previous
+
+
 def make_scorer(reads: Sequence[bytes], config: CdwfaConfig) -> WavefrontScorer:
     """Instantiate the scorer selected by ``config.backend``, wrapped in
-    the fault-tolerant supervisor when the config asks for one."""
+    the fault-tolerant supervisor when the config asks for one, then in
+    the calling thread's scorer decorator when one is installed (see
+    :func:`set_scorer_decorator`)."""
     if config.supervised or config.backend_chain is not None:
         from waffle_con_tpu.runtime.supervisor import BackendSupervisor
 
-        return BackendSupervisor(reads, config)
-    return construct_backend(reads, config, config.backend)
+        scorer: WavefrontScorer = BackendSupervisor(reads, config)
+    else:
+        scorer = construct_backend(reads, config, config.backend)
+    decorator = getattr(_SCORER_HOOK, "decorator", None)
+    if decorator is not None:
+        scorer = decorator(scorer)
+    return scorer

@@ -10,6 +10,12 @@ Events are plain dicts with a ``kind`` key; everything else is
 kind-specific detail.  The log is intentionally unbounded-ish but
 capped defensively: a pathological retry loop must not turn the event
 log itself into the memory leak.
+
+Thread-safety contract: every append (:func:`record`), drain
+(:func:`clear_events`), and read (:func:`get_events`,
+:func:`summarize_events`) holds ``_LOCK`` — the serve layer records
+from many worker threads plus the batching-dispatcher thread into this
+one list, and readers get point-in-time copies, never live aliases.
 """
 
 from __future__ import annotations

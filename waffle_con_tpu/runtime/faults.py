@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import List, Optional
 
 import numpy as np
@@ -100,10 +101,16 @@ class FaultSpec:
 
 
 class FaultPlan:
-    """An ordered set of fault rules consulted by the runtime hooks."""
+    """An ordered set of fault rules consulted by the runtime hooks.
+
+    ``poll`` is serialized by a plan-level lock: the serve layer runs
+    many supervised jobs on worker threads against one process-wide
+    plan, and a ``count``-bounded rule must fire exactly ``count`` times
+    total, not ``count`` times per racing thread."""
 
     def __init__(self, specs: Optional[List[FaultSpec]] = None) -> None:
         self.specs: List[FaultSpec] = list(specs or [])
+        self._lock = threading.Lock()
 
     def add(
         self,
@@ -121,17 +128,21 @@ class FaultPlan:
         kinds: Optional[tuple] = None,
     ) -> Optional[FaultSpec]:
         """First matching rule (its firing consumed), or ``None``."""
-        for spec in self.specs:
-            if kinds is not None and spec.kind not in kinds:
-                continue
-            if spec.matches(backend, op, index):
-                spec.fired += 1
-                events.record(
-                    "fault_injected", fault=spec.kind, backend=backend,
-                    op=op, index=index,
-                )
-                return spec
-        return None
+        with self._lock:
+            fired = None
+            for spec in self.specs:
+                if kinds is not None and spec.kind not in kinds:
+                    continue
+                if spec.matches(backend, op, index):
+                    spec.fired += 1
+                    fired = spec
+                    break
+        if fired is not None:
+            events.record(
+                "fault_injected", fault=fired.kind, backend=backend,
+                op=op, index=index,
+            )
+        return fired
 
 
 #: the installed plan; ``None`` means "not yet resolved from the env"

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Dict, Optional
 
 from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS
@@ -27,6 +28,40 @@ logger = logging.getLogger(__name__)
 
 class WatchdogError(RuntimeError):
     """Strict-mode budget violation."""
+
+
+class DeadlineExceeded(WatchdogError):
+    """A per-job wall-clock deadline expired (serve layer).
+
+    Subclasses :class:`WatchdogError` so callers that already treat
+    watchdog violations as "the runtime stopped this search on purpose"
+    handle deadlines the same way.
+    """
+
+
+def enforce_deadline(
+    deadline_monotonic: Optional[float], label: str = ""
+) -> None:
+    """Raise :class:`DeadlineExceeded` when ``time.monotonic()`` is past
+    ``deadline_monotonic`` (``None`` = no deadline; a no-op).
+
+    The serve layer calls this at job admission-queue pop and before
+    every routed scorer dispatch, so a job whose deadline lapses stops
+    at the next dispatch boundary rather than running to completion.
+    Records a ``deadline_exceeded`` runtime event on the way out.
+    """
+    if deadline_monotonic is None:
+        return
+    now = time.monotonic()
+    if now >= deadline_monotonic:
+        overrun = now - deadline_monotonic
+        events.record(
+            "deadline_exceeded", label=label, overrun_s=round(overrun, 6)
+        )
+        raise DeadlineExceeded(
+            f"deadline exceeded{f' ({label})' if label else ''}: "
+            f"{overrun * 1000:.1f} ms past the per-job budget"
+        )
 
 
 def dispatch_total(counters: Dict[str, int]) -> int:

@@ -1,0 +1,64 @@
+"""Multi-tenant consensus serving with cross-job dynamic batching.
+
+The serving layer the ROADMAP's "heavy traffic" north star needs on top
+of the single-search engine stack:
+
+* :class:`~waffle_con_tpu.serve.service.ConsensusService` — accepts
+  many independent jobs (single/dual/priority), bounded admission queue
+  with reject-on-full backpressure, priority scheduling (FIFO within a
+  class), per-job deadlines and cancellation enforced at every scorer
+  dispatch boundary, graceful/shedding shutdown.
+* :class:`~waffle_con_tpu.serve.dispatcher.BatchingDispatcher` — the
+  cross-job coalescing point: concurrent jobs' blocking scorer
+  dispatches are collected within a bounded batching window, grouped by
+  compiled-shape bucket, and executed as one device-resident burst by a
+  single dispatcher thread (direct fall-through when a job is alone).
+  Results are byte-identical to serial execution by construction.
+* :class:`~waffle_con_tpu.serve.dispatcher.CoalescingScorer` — the
+  per-job transparent scorer proxy (same seam as ``obs.TimedScorer``
+  and the runtime's ``BackendSupervisor``) that routes dispatches into
+  the shared dispatcher.
+
+Observability: ``waffle_serve_queue_depth``/``waffle_serve_active_jobs``
+gauges, ``waffle_serve_jobs_total{outcome}`` /
+``waffle_serve_admission_rejections_total`` /
+``waffle_serve_direct_dispatches_total`` counters, and the
+``waffle_serve_batch_occupancy`` / ``waffle_serve_job_latency_seconds``
+histograms (all gated on ``WAFFLE_METRICS``).
+"""
+
+from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
+from waffle_con_tpu.serve.dispatcher import (
+    BatchingDispatcher,
+    CoalescingScorer,
+    bucket_key,
+)
+from waffle_con_tpu.serve.job import (
+    JobCancelled,
+    JobHandle,
+    JobRequest,
+    JobStatus,
+    ServeError,
+    ServiceClosed,
+    ServiceOverloaded,
+)
+from waffle_con_tpu.serve.scheduler import AdmissionQueue, WorkerPool
+from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+
+__all__ = [
+    "AdmissionQueue",
+    "BatchingDispatcher",
+    "CoalescingScorer",
+    "ConsensusService",
+    "DeadlineExceeded",
+    "JobCancelled",
+    "JobHandle",
+    "JobRequest",
+    "JobStatus",
+    "ServeConfig",
+    "ServeError",
+    "ServiceClosed",
+    "ServiceOverloaded",
+    "WorkerPool",
+    "bucket_key",
+]

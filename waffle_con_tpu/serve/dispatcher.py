@@ -1,0 +1,335 @@
+"""Cross-job dynamic batching at the scorer-dispatch boundary.
+
+The engines' host-side search is strictly sequential *within* a job —
+each blocking scorer dispatch depends on the previous one's result — so
+a single job can never batch with itself.  But N concurrent jobs each
+have (at most) one dispatch in flight at any moment, and on a tunneled
+device platform each dispatch pays the same launch/transfer overhead
+the TPU-serving literature coalesces away (Ragged Paged Attention,
+arXiv:2604.15464).  :class:`BatchingDispatcher` is that coalescing
+point: worker threads park their job's next dispatch in a shared pend
+list, a single dispatcher thread collects everything that arrives
+within a bounded batching window, groups the batch by *bucket*
+(backend + padded read-count/read-length geometry, the shapes that
+share an XLA compilation), and executes each group back-to-back as one
+device-resident burst.
+
+What is and is not fused: each job's scorer owns its own device state
+and reads arrays (``ops/jax_scorer.py`` keeps one ``[branch, read,
+2E+1]`` state per scorer), so requests are *not* merged into a single
+XLA call — results stay byte-identical to serial execution by
+construction, because every request runs its own ``fn()`` against its
+own scorer, in deterministic submission order within the group.  The
+win is scheduling-level: one thread owns the device (no GIL/dispatch
+interleaving), bucket grouping runs same-compiled-shape kernels
+consecutively, and per-dispatch sync overhead is amortized across the
+group.  Batch occupancy (requests per executed group) is the quantity
+to watch — ``waffle_serve_batch_occupancy`` — and the service's bench
+mode reports its mean.
+
+When a job is alone (``active_jobs <= 1``), dispatch falls through to
+a direct call on the worker thread: a single-tenant service pays no
+batching-window latency at all.
+
+:class:`CoalescingScorer` is the per-job proxy that routes the scorer
+protocol's blocking dispatch methods (the same vocabulary as
+``obs.TimedScorer``) into the dispatcher; everything else — attribute
+reads, capability feature-tests (``getattr(scorer, "run_extend",
+None)``), the two-way live ``counters`` view — passes through
+untouched, so engines cannot tell they are being served.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import trace as obs_trace
+from waffle_con_tpu.obs.instrument import TIMED_OPS
+from waffle_con_tpu.serve.job import ServiceClosed
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 0 else 0
+
+
+def bucket_key(scorer) -> tuple:
+    """Shape bucket of a job's scorer: jobs in the same bucket run the
+    same compiled kernels (backend + power-of-two-padded read count and
+    max read length + alphabet size), so executing them consecutively
+    keeps one compiled program hot instead of ping-ponging."""
+    reads = getattr(scorer, "reads", []) or []
+    config = getattr(scorer, "config", None)
+    backend = getattr(config, "backend", "?")
+    max_len = max((len(r) for r in reads), default=0)
+    return (
+        backend,
+        _pow2_ceil(len(reads)),
+        _pow2_ceil(max_len),
+        int(getattr(scorer, "num_symbols", 0) or 0),
+    )
+
+
+class _DispatchRequest:
+    __slots__ = ("ticket", "bucket", "op", "fn", "result", "exception",
+                 "done")
+
+    def __init__(self, ticket, bucket, op, fn) -> None:
+        self.ticket = ticket
+        self.bucket = bucket
+        self.op = op
+        self.fn = fn
+        self.result = None
+        self.exception: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+class BatchingDispatcher:
+    """Single-threaded executor coalescing concurrent scorer dispatches.
+
+    ``window_s`` bounds how long the first request of a batch waits for
+    company; ``max_batch`` bounds how much company it waits *for* (the
+    wait target is ``min(max_batch, active_jobs)`` — there is no point
+    waiting for more requests than there are jobs able to send one).
+    """
+
+    def __init__(
+        self,
+        window_s: float = 0.002,
+        max_batch: int = 8,
+        name: str = "consensus",
+    ) -> None:
+        if window_s < 0:
+            raise ValueError("window_s must be >= 0")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._name = name
+        self._cond = threading.Condition()
+        self._pending: List[_DispatchRequest] = []
+        self._active_jobs = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # internal stats, always maintained (cheap ints under the lock);
+        # the obs serve_* metrics mirror them when metrics are enabled
+        self._stats = {
+            "coalesced_batches": 0,   # executed groups with >= 2 requests
+            "solo_batches": 0,        # executed groups of exactly 1
+            "routed_requests": 0,     # requests through the dispatcher
+            "direct_dispatches": 0,   # fell through (job alone / closed)
+            "occupancy_sum": 0,
+            "occupancy_max": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        with self._cond:
+            if self._thread is not None or self._closed:
+                return
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"waffle-serve-{self._name}-dispatcher",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        """Stop the dispatcher thread; drains already-parked requests
+        before exiting, then fails anything that raced in."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+        with self._cond:
+            leftovers = self._pending[:]
+            del self._pending[:]
+        for req in leftovers:
+            req.exception = ServiceClosed("dispatcher closed mid-dispatch")
+            req.done.set()
+
+    # -- job accounting ------------------------------------------------
+
+    def job_started(self) -> None:
+        with self._cond:
+            self._active_jobs += 1
+
+    def job_finished(self) -> None:
+        with self._cond:
+            self._active_jobs = max(0, self._active_jobs - 1)
+
+    # -- the dispatch path ---------------------------------------------
+
+    def dispatch(self, ticket, bucket: tuple, op: str, fn):
+        """Run one blocking scorer dispatch, coalescing with concurrent
+        jobs when possible.  ``ticket.check_abort(op)`` gates both entry
+        and execution so cancellations/deadlines bite at this boundary.
+        """
+        if ticket is not None:
+            ticket.check_abort(op)
+        with self._cond:
+            direct = (
+                self._closed
+                or self._thread is None
+                or not self._thread.is_alive()
+                or self._active_jobs <= 1
+                or self.window_s <= 0
+                or threading.current_thread() is self._thread
+            )
+            if direct:
+                self._stats["direct_dispatches"] += 1
+            else:
+                req = _DispatchRequest(ticket, bucket, op, fn)
+                self._pending.append(req)
+                self._stats["routed_requests"] += 1
+                self._cond.notify_all()
+        if direct:
+            if obs_metrics.metrics_enabled():
+                obs_metrics.registry().counter(
+                    "waffle_serve_direct_dispatches_total",
+                    service=self._name,
+                ).inc()
+            return fn()
+        # park until the dispatcher delivers; poll so a dispatcher that
+        # died on an unexpected error cannot strand the worker forever
+        while not req.done.wait(0.25):
+            with self._cond:
+                thread_dead = (
+                    self._thread is None or not self._thread.is_alive()
+                )
+            if thread_dead and not req.done.is_set():
+                raise ServiceClosed(
+                    "batching dispatcher thread died mid-dispatch"
+                )
+        if req.exception is not None:
+            raise req.exception
+        return req.result
+
+    # -- dispatcher thread ---------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._pending:
+                    return
+                # bounded batching window: wait for company up to
+                # window_s, but never for more requests than there are
+                # other active jobs to send them
+                target = min(self.max_batch, max(2, self._active_jobs))
+                deadline = time.monotonic() + self.window_s
+                while len(self._pending) < target and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch = self._pending[:]
+                del self._pending[:]
+            self._execute(batch)
+
+    def _execute(self, batch: List[_DispatchRequest]) -> None:
+        # group by shape bucket, preserving arrival order within and
+        # across groups (first-seen bucket runs first)
+        groups: Dict[tuple, List[_DispatchRequest]] = {}
+        for req in batch:
+            groups.setdefault(req.bucket, []).append(req)
+        metrics_on = obs_metrics.metrics_enabled()
+        for bucket, reqs in groups.items():
+            occupancy = len(reqs)
+            with self._cond:
+                if occupancy > 1:
+                    self._stats["coalesced_batches"] += 1
+                else:
+                    self._stats["solo_batches"] += 1
+                self._stats["occupancy_sum"] += occupancy
+                self._stats["occupancy_max"] = max(
+                    self._stats["occupancy_max"], occupancy
+                )
+            if metrics_on:
+                obs_metrics.registry().histogram(
+                    "waffle_serve_batch_occupancy",
+                    buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+                    service=self._name,
+                ).observe(occupancy)
+            with obs_trace.span(
+                "serve:batch", "serve",
+                bucket=str(bucket), occupancy=occupancy,
+            ):
+                for req in reqs:
+                    try:
+                        if req.ticket is not None:
+                            req.ticket.check_abort(req.op)
+                        req.result = req.fn()
+                    except BaseException as exc:  # delivered to the worker
+                        req.exception = exc
+                    finally:
+                        req.done.set()
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._cond:
+            s = dict(self._stats)
+        batches = s["coalesced_batches"] + s["solo_batches"]
+        s["batches"] = batches
+        s["mean_batch_occupancy"] = (
+            s["occupancy_sum"] / batches if batches else 0.0
+        )
+        return s
+
+
+class CoalescingScorer:
+    """Per-job scorer proxy routing blocking dispatches into a shared
+    :class:`BatchingDispatcher`.
+
+    Same transparency contract as ``obs.TimedScorer`` (which it may be
+    stacked on top of): attribute access falls through to the wrapped
+    scorer so capability feature-tests see exactly the backend's
+    surface, ``counters`` stays a live two-way view (the supervisor
+    swaps in shared dicts by plain assignment), and wrapped methods are
+    cached in the instance dict after first touch — safe because the
+    wrapped scorer's capability surface is fixed after construction.
+    """
+
+    def __init__(self, base, dispatcher: BatchingDispatcher, ticket) -> None:
+        self._base = base
+        self._dispatcher = dispatcher
+        self._ticket = ticket
+        self._bucket = bucket_key(base)
+
+    @property
+    def counters(self):
+        return self._base.counters
+
+    @counters.setter
+    def counters(self, value):
+        self._base.counters = value
+
+    @property
+    def coalesce_bucket(self) -> tuple:
+        return self._bucket
+
+    def __getattr__(self, name: str):
+        base = self.__dict__["_base"]
+        attr = getattr(base, name)
+        op = TIMED_OPS.get(name)
+        if op is None or not callable(attr):
+            return attr
+        dispatcher = self.__dict__["_dispatcher"]
+        ticket = self.__dict__["_ticket"]
+        bucket = self.__dict__["_bucket"]
+
+        def routed(*args, **kwargs):
+            return dispatcher.dispatch(
+                ticket, bucket, op, lambda: attr(*args, **kwargs)
+            )
+
+        routed.__name__ = name
+        self.__dict__[name] = routed
+        return routed

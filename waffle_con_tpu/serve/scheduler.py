@@ -1,0 +1,155 @@
+"""Admission control and worker pool for the consensus service.
+
+:class:`AdmissionQueue` is a *bounded* priority queue: higher
+``JobRequest.priority`` pops first, FIFO within a priority class (a
+monotonically increasing sequence number breaks ties, and makes heap
+entries totally ordered without ever comparing handles).  A full queue
+**rejects** with :class:`~waffle_con_tpu.serve.job.ServiceOverloaded`
+instead of blocking the submitter — under overload the caller must get
+a fast typed answer it can retry/shed on, not a stalled thread.
+
+:class:`WorkerPool` is a fixed set of daemon threads draining the queue
+through a job-runner callable supplied by the service.  Workers are
+deliberately dumb: all lifecycle logic (skip-if-cancelled, deadline at
+pop, engine construction, finalization) lives in
+``ConsensusService._run_job``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Callable, List, Optional
+
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.serve.job import (
+    JobHandle,
+    ServiceClosed,
+    ServiceOverloaded,
+)
+
+
+class AdmissionQueue:
+    """Bounded priority queue with reject-on-full backpressure."""
+
+    def __init__(self, limit: int, name: str = "consensus") -> None:
+        if limit < 1:
+            raise ValueError("queue limit must be >= 1")
+        self.limit = limit
+        self._name = name
+        self._cond = threading.Condition()
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._closed = False
+
+    def _set_depth_gauge(self, depth: int) -> None:
+        if obs_metrics.metrics_enabled():
+            obs_metrics.registry().gauge(
+                "waffle_serve_queue_depth", service=self._name
+            ).set(depth)
+
+    def put(self, handle: JobHandle) -> None:
+        """Enqueue or raise — never blocks on a full queue."""
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed("service is closed to new jobs")
+            if len(self._heap) >= self.limit:
+                if obs_metrics.metrics_enabled():
+                    obs_metrics.registry().counter(
+                        "waffle_serve_admission_rejections_total",
+                        service=self._name,
+                    ).inc()
+                raise ServiceOverloaded(
+                    f"admission queue full ({self.limit} jobs queued); "
+                    "retry later or shed load"
+                )
+            heapq.heappush(
+                self._heap,
+                (-handle.request.priority, self._seq, handle),
+            )
+            self._seq += 1
+            depth = len(self._heap)
+            self._cond.notify()
+        self._set_depth_gauge(depth)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[JobHandle]:
+        """Pop the best job, or ``None`` on timeout / closed-and-empty."""
+        with self._cond:
+            while not self._heap:
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+            _neg_prio, _seq, handle = heapq.heappop(self._heap)
+            depth = len(self._heap)
+        self._set_depth_gauge(depth)
+        return handle
+
+    def drain(self) -> List[JobHandle]:
+        """Remove and return every queued job (shutdown path)."""
+        with self._cond:
+            handles = [h for _p, _s, h in self._heap]
+            self._heap.clear()
+        self._set_depth_gauge(0)
+        return handles
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._heap)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class WorkerPool:
+    """Fixed pool of daemon threads feeding jobs to ``run_job``."""
+
+    def __init__(
+        self,
+        workers: int,
+        queue: AdmissionQueue,
+        run_job: Callable[[JobHandle], None],
+        name: str = "consensus",
+    ) -> None:
+        if workers < 1:
+            raise ValueError("need at least one worker")
+        self._queue = queue
+        self._run_job = run_job
+        self._name = name
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._loop,
+                name=f"waffle-serve-{name}-w{i}",
+                daemon=True,
+            )
+            for i in range(workers)
+        ]
+        self._started = False
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for t in self._threads:
+            t.start()
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            handle = self._queue.get(timeout=0.05)
+            if handle is None:
+                continue
+            self._run_job(handle)
+
+    def stop(self, wait: bool = True) -> None:
+        self._stop.set()
+        self._queue.close()
+        if wait and self._started:
+            for t in self._threads:
+                t.join()

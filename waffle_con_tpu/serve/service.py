@@ -1,0 +1,296 @@
+"""The multi-tenant consensus service.
+
+:class:`ConsensusService` accepts independent consensus jobs
+(:class:`~waffle_con_tpu.serve.job.JobRequest`), admits them through a
+bounded priority queue (reject-on-full backpressure), runs them on a
+worker pool, and coalesces the concurrent jobs' scorer dispatches via
+the shared :class:`~waffle_con_tpu.serve.dispatcher.BatchingDispatcher`.
+
+The engines are untouched: each worker installs a thread-local scorer
+decorator (``ops.scorer.set_scorer_decorator``) for the duration of its
+job, so every scorer the engine builds — supervised or not — is wrapped
+in a :class:`~waffle_con_tpu.serve.dispatcher.CoalescingScorer` routing
+dispatches into the shared dispatcher with the job's handle as abort
+ticket.  Fault tolerance composes for free: a job whose config asks for
+supervision (``supervised``/``backend_chain``) gets its supervisor
+*inside* the coalescing proxy, so retries, demotions and the circuit
+breaker all happen within one routed dispatch.
+
+Lifecycle: ``submit`` → QUEUED → (worker pop, deadline/cancel check) →
+RUNNING → DONE / FAILED / CANCELLED / EXPIRED.  ``close()`` drains
+gracefully by default (runs everything already admitted) or sheds the
+queue with ``cancel_pending=True``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
+from waffle_con_tpu.serve.dispatcher import BatchingDispatcher, CoalescingScorer
+from waffle_con_tpu.serve.job import (
+    JobCancelled,
+    JobHandle,
+    JobRequest,
+    JobStatus,
+    ServiceClosed,
+    ServiceOverloaded,
+)
+from waffle_con_tpu.serve.scheduler import AdmissionQueue, WorkerPool
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Service knobs.
+
+    * ``workers`` — concurrent jobs in flight (also the natural upper
+      bound on batch occupancy).
+    * ``queue_limit`` — bounded admission queue; the (queue_limit+1)-th
+      concurrent submit gets :class:`ServiceOverloaded`.
+    * ``batch_window_s`` — how long the first dispatch of a batch waits
+      for concurrent company before executing (0 disables coalescing).
+    * ``max_batch`` — batch-size wait target for the window.
+    """
+
+    workers: int = 4
+    queue_limit: int = 64
+    batch_window_s: float = 0.002
+    max_batch: int = 8
+    name: str = "consensus"
+
+    def __post_init__(self) -> None:
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+        if self.queue_limit < 1:
+            raise ValueError("queue_limit must be >= 1")
+        if self.batch_window_s < 0:
+            raise ValueError("batch_window_s must be >= 0")
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+
+
+def _build_engine(request: JobRequest):
+    """Instantiate the engine for one job (mirrors ``bench._make_engine``
+    plus offset seeding).  Imports are local to keep ``serve`` importable
+    without pulling the model stack in at module-import time."""
+    from waffle_con_tpu.config import CdwfaConfig
+    from waffle_con_tpu.models.consensus import ConsensusDWFA
+    from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+    from waffle_con_tpu.models.priority_consensus import PriorityConsensusDWFA
+
+    config = request.config if request.config is not None else CdwfaConfig()
+    if request.kind == "priority":
+        engine = PriorityConsensusDWFA(config)
+        for chain in request.reads:
+            engine.add_sequence_chain(list(chain))
+        return engine
+    cls = ConsensusDWFA if request.kind == "single" else DualConsensusDWFA
+    engine = cls(config)
+    offsets = request.offsets or (None,) * len(request.reads)
+    for read, offset in zip(request.reads, offsets):
+        engine.add_sequence_offset(read, offset)
+    return engine
+
+
+class ConsensusService:
+    """Accepts, schedules, and batch-serves consensus jobs.
+
+    Usage::
+
+        with ConsensusService(ServeConfig(workers=4)) as svc:
+            handles = [svc.submit(req) for req in requests]
+            results = [h.result() for h in handles]
+
+    ``autostart=False`` builds the service with workers and dispatcher
+    parked (tests use this to exercise admission-queue semantics with
+    zero timing dependence); call :meth:`start` to begin serving.
+    """
+
+    def __init__(
+        self,
+        config: Optional[ServeConfig] = None,
+        autostart: bool = True,
+    ) -> None:
+        self.config = config if config is not None else ServeConfig()
+        self._queue = AdmissionQueue(
+            self.config.queue_limit, name=self.config.name
+        )
+        self._dispatcher = BatchingDispatcher(
+            window_s=self.config.batch_window_s,
+            max_batch=self.config.max_batch,
+            name=self.config.name,
+        )
+        self._pool = WorkerPool(
+            self.config.workers, self._queue, self._run_job,
+            name=self.config.name,
+        )
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        self._handles: List[JobHandle] = []
+        self._counts = {
+            "submitted": 0, "rejected": 0, "done": 0, "failed": 0,
+            "cancelled": 0, "expired": 0,
+        }
+        if autostart:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._dispatcher.start()
+        self._pool.start()
+
+    def close(
+        self, cancel_pending: bool = False, timeout: Optional[float] = None
+    ) -> None:
+        """Shut down.  Default drains gracefully: everything already
+        admitted runs to completion first.  ``cancel_pending=True``
+        finalizes still-queued jobs as CANCELLED instead."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            handles = list(self._handles)
+        if cancel_pending:
+            for handle in self._queue.drain():
+                handle.cancel()
+        if self._pool.started:
+            for handle in handles:
+                handle.wait(timeout)
+        self._pool.stop(wait=True)
+        # any job still queued when the pool stopped (never-started
+        # service, or drain raced a worker) must not hang its client
+        for handle in self._queue.drain():
+            handle._finish(
+                JobStatus.CANCELLED,
+                exception=ServiceClosed("service closed before job ran"),
+            )
+        self._dispatcher.close()
+
+    def __enter__(self) -> "ConsensusService":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    # -- client API ----------------------------------------------------
+
+    def submit(self, request: JobRequest) -> JobHandle:
+        """Admit one job; raises :class:`ServiceOverloaded` when the
+        bounded queue is full and :class:`ServiceClosed` after close."""
+        if not isinstance(request, JobRequest):
+            raise TypeError(
+                f"expected JobRequest, got {type(request).__name__}"
+            )
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is closed to new jobs")
+            handle = JobHandle(self._next_id, request)
+            self._next_id += 1
+        try:
+            self._queue.put(handle)
+        except ServiceOverloaded:
+            with self._lock:
+                self._counts["rejected"] += 1
+            events.record(
+                "serve_overloaded", job_kind=request.kind,
+                queue_limit=self.config.queue_limit,
+            )
+            raise
+        with self._lock:
+            self._counts["submitted"] += 1
+            self._handles.append(handle)
+        return handle
+
+    def submit_all(self, requests: Sequence[JobRequest]) -> List[JobHandle]:
+        return [self.submit(r) for r in requests]
+
+    # -- worker --------------------------------------------------------
+
+    def _run_job(self, handle: JobHandle) -> None:
+        from waffle_con_tpu.ops.scorer import set_scorer_decorator
+
+        if not handle._mark_running():
+            # cancelled while queued: finalized by cancel() already,
+            # account it now that its heap entry has been consumed
+            self._account(handle, "cancelled")
+            return
+        try:
+            handle.check_abort()  # deadline may already have lapsed
+        except BaseException as exc:
+            self._finalize(handle, exc)
+            return
+        self._dispatcher.job_started()
+        dispatcher, ticket = self._dispatcher, handle
+        previous = set_scorer_decorator(
+            lambda scorer: CoalescingScorer(scorer, dispatcher, ticket)
+        )
+        try:
+            engine = _build_engine(handle.request)
+            result = engine.consensus()
+        except BaseException as exc:
+            self._finalize(handle, exc)
+        else:
+            handle._finish(
+                JobStatus.DONE, result=result,
+                report=getattr(engine, "last_search_report", None),
+            )
+            self._account(handle, "done")
+        finally:
+            set_scorer_decorator(previous)
+            self._dispatcher.job_finished()
+
+    def _finalize(self, handle: JobHandle, exc: BaseException) -> None:
+        if isinstance(exc, JobCancelled):
+            handle._finish(JobStatus.CANCELLED, exception=exc)
+            self._account(handle, "cancelled")
+        elif isinstance(exc, DeadlineExceeded):
+            handle._finish(JobStatus.EXPIRED, exception=exc)
+            self._account(handle, "expired")
+        else:
+            handle._finish(JobStatus.FAILED, exception=exc)
+            self._account(handle, "failed")
+
+    def _account(self, handle: JobHandle, outcome: str) -> None:
+        with self._lock:
+            self._counts[outcome] += 1
+        if obs_metrics.metrics_enabled():
+            reg = obs_metrics.registry()
+            reg.counter(
+                "waffle_serve_jobs_total",
+                service=self.config.name, outcome=outcome,
+            ).inc()
+            latency = handle.latency_s
+            if latency is not None:
+                reg.histogram(
+                    "waffle_serve_job_latency_seconds",
+                    service=self.config.name,
+                ).observe(latency)
+            reg.gauge(
+                "waffle_serve_active_jobs", service=self.config.name
+            ).set(self._active_jobs())
+
+    def _active_jobs(self) -> int:
+        with self._lock:
+            counts = dict(self._counts)
+        finished = (counts["done"] + counts["failed"]
+                    + counts["cancelled"] + counts["expired"])
+        return max(0, counts["submitted"] - finished - self._queue.depth())
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Point-in-time counters + dispatcher batching stats (the
+        bench's ``--serve`` evidence embeds this dict verbatim)."""
+        with self._lock:
+            counts = dict(self._counts)
+        return {
+            "jobs": counts,
+            "queue_depth": self._queue.depth(),
+            "dispatch": self._dispatcher.stats(),
+        }
