@@ -392,6 +392,77 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
     return out
 
 
+def bench_microbench(num_reads, seq_len, error_rate, iters=3):
+    """Raw device hot-loop throughput: time ``run_extend`` engagements
+    of the north-star geometry directly on a ``JaxScorer``, without the
+    engine's host-side search bookkeeping.  This is the steps/s
+    regression gate CI asserts a floor on — it isolates the per-step
+    cost of the lean device loop, so a device-loop regression cannot
+    hide behind host-side wins (or vice versa).
+
+    Parity cross-check rides along for free: at 1% error and
+    ``min_count = reads/4`` the whole sequence is one unambiguous run,
+    so the appended bytes must equal the generator's ground truth.
+    """
+    import numpy as np
+
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    min_count = max(2, num_reads // 4)
+    truth, reads = generate_test(4, seq_len, num_reads, error_rate, seed=0)
+    band = _band_seed(seq_len, error_rate)
+    cfg = (
+        CdwfaConfigBuilder()
+        .min_count(min_count)
+        .backend("jax")
+        .initial_band(band)
+        .build()
+    )
+    scorer = JaxScorer(reads, cfg)
+    budget = 2**31 - 1
+
+    def engage():
+        h = scorer.root(np.ones(num_reads, dtype=bool))
+        steps, code, appended, _stats, _recs = scorer.run_extend(
+            h, b"", budget, budget, 0, min_count, False, seq_len
+        )
+        scorer.free(h)
+        return steps, code, appended
+
+    compile_start = time.perf_counter()
+    steps, code, appended = engage()  # warm-up: compiles the run kernel
+    compile_time = time.perf_counter() - compile_start
+    parity = appended == truth
+
+    best = None
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        steps, code, appended = engage()
+        dt = time.perf_counter() - t0
+        if best is None or dt < best:
+            best = dt
+        parity = parity and appended == truth
+    steps_per_s = steps / max(best, 1e-9)
+    return {
+        "metric": f"microbench_run_extend_{num_reads}x{seq_len}_steps_per_s",
+        "value": round(steps_per_s, 1),
+        "unit": "steps/s",
+        "n_iters": max(1, iters),
+        "steps": int(steps),
+        "stop_code": int(code),
+        "best_engagement_s": round(best, 4),
+        "parity": bool(parity),
+        "breakdown": {
+            "warmup_incl_compile_s": round(compile_time, 2),
+            "initial_band": band,
+            "run_pallas_calls": scorer.counters.get("run_pallas_calls", 0),
+            "runtime_events": _runtime_events(),
+        },
+    }
+
+
 def bench_dual(num_reads, seq_len, error_rate, iters=5, trace_out=None):
     """Dual north-star: two haplotypes differing by 3 SNPs, half the reads
     each; CPU baseline is the complete C++ dual engine."""
@@ -905,6 +976,17 @@ def main() -> None:
         "iteration SearchReport in the evidence JSON",
     )
     parser.add_argument(
+        "--microbench", action="store_true",
+        help="raw run_extend hot-loop steps/s (no engine host logic); "
+        "one JSON line with the parity cross-check",
+    )
+    parser.add_argument(
+        "--assert-steps-floor", type=float, default=None, metavar="S",
+        dest="steps_floor",
+        help="with --microbench: exit 1 unless steps/s >= S and the "
+        "parity cross-check passed (the CI regression gate)",
+    )
+    parser.add_argument(
         "--serve", type=int, default=None, metavar="N",
         help="serving-throughput mode: N concurrent jobs through "
         "ConsensusService; reports jobs/s, mean batch occupancy, and "
@@ -925,9 +1007,34 @@ def main() -> None:
     # never touches jax in the parent (children carry --platform)
     if args.platform == "cpu" and (
         args._run or args._gate or args.grid or args.dual or args.priority
-        or args.serve
+        or args.serve or args.microbench
     ):
         _force_cpu_backend()
+
+    if args.microbench:
+        from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
+        out = bench_microbench(
+            args.reads or (16 if smoke else 256),
+            args.seq_len or (1000 if smoke else 10_000),
+            0.01,
+            iters=args.iters,
+        )
+        out["device_platform"] = _current_platform()
+        print(json.dumps(out))
+        if args.steps_floor is not None:
+            ok = out["parity"] and out["value"] >= args.steps_floor
+            if not ok:
+                print(
+                    f"FAIL: steps/s {out['value']} < floor "
+                    f"{args.steps_floor} or parity lost "
+                    f"(parity={out['parity']})",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        return
 
     if args.serve:
         from waffle_con_tpu.utils.cache import enable_compilation_cache

@@ -56,6 +56,15 @@ print(
 )
 PY
 
+echo "== hot-loop microbench (steps/s regression gate) =="
+# Raw run_extend throughput at the north-star geometry (256 reads x
+# 10 kb, 1% error): the floor is 1.5x the r05 baseline (413 steps/s);
+# the mode also cross-checks the appended bytes against ground truth,
+# so a parity break fails the gate even when throughput holds.
+MICRO_FLOOR="${WAFFLE_MICROBENCH_FLOOR:-620}"
+python bench.py --microbench --platform cpu --iters 3 \
+  --assert-steps-floor "$MICRO_FLOOR"
+
 echo "== serve bench smoke (cross-job batching) =="
 SERVE_OUT="$(mktemp /tmp/waffle_ci_serve.XXXXXX.json)"
 trap 'rm -f "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT"' EXIT

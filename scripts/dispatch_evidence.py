@@ -16,10 +16,24 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 
-if "--platform" not in " ".join(sys.argv) or "cpu" in sys.argv:
-    jax.config.update("jax_platforms", "cpu")  # reliable CPU pin (see bench.py)
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dual", nargs=2, type=int, default=None)
+    parser.add_argument("--priority", nargs=2, type=int, default=None)
+    parser.add_argument("--platform", default="cpu", choices=["cpu", "device"])
+    return parser.parse_args(argv)
+
+
+# Parse BEFORE anything imports jax: the platform pin must be decided by
+# real argparse semantics (``--platform=cpu`` is ONE argv token — the
+# old substring sniff missed it and let jax grab the device), and
+# setting JAX_PLATFORMS in the env ahead of the import pins it however
+# late the backend initializes.
+if __name__ == "__main__":
+    _ARGS = _parse_args()
+    if _ARGS.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 
@@ -27,6 +41,8 @@ from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS as DISPATCH_KEYS
 
 
 def _plat():
+    import jax
+
     return "jax" + jax.devices()[0].platform
 
 
@@ -143,11 +159,7 @@ def run_priority(num_reads, seq_len):
 
 
 def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--dual", nargs=2, type=int, default=None)
-    parser.add_argument("--priority", nargs=2, type=int, default=None)
-    parser.add_argument("--platform", default="cpu", choices=["cpu", "device"])
-    args = parser.parse_args()
+    args = _ARGS if __name__ == "__main__" else _parse_args()
 
     from waffle_con_tpu.utils.cache import enable_compilation_cache
 

@@ -1,0 +1,144 @@
+"""Fast-path dispatch cache: the engines must resolve the scorer's
+optional-capability surface (run_extend / run_extend_dual / run_arena /
+clone_push_many and the ARENA_* constants) a constant number of times
+per search — NOT once per pop — and a supervised backend swap must
+invalidate the cached snapshot via ``fastpath_gen``.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.ops.scorer import fast_paths, set_scorer_decorator
+from waffle_con_tpu.runtime.supervisor import BackendSupervisor
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+#: the optional-capability names the engines feature-test; resolving any
+#: of these through the proxy stack is the cost the cache amortizes
+FAST_PATH_NAMES = (
+    "run_extend", "run_extend_dual", "run_arena", "clone_push_many",
+    "ARENA_CAP", "ARENA_K", "ARENA_CRE_PER_EVENT", "ARENA_TAKE_MAX",
+)
+
+
+class _ProbeScorer:
+    """Transparent delegating proxy that counts every dynamic resolution
+    of a fast-path attribute (the same shape as CoalescingScorer /
+    TimedScorer: plain ``__getattr__`` forwarding, two-way ``counters``)."""
+
+    def __init__(self, base):
+        self.__dict__["_base"] = base
+        self.__dict__["probe_counts"] = collections.Counter()
+
+    @property
+    def counters(self):
+        return self.__dict__["_base"].counters
+
+    @counters.setter
+    def counters(self, value):
+        self.__dict__["_base"].counters = value
+
+    def __getattr__(self, name):
+        if name in FAST_PATH_NAMES:
+            self.__dict__["probe_counts"][name] += 1
+        return getattr(self.__dict__["_base"], name)
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().min_count(2).backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _run_probed(engine_cls, reads, cfg):
+    probes = []
+
+    def deco(scorer):
+        p = _ProbeScorer(scorer)
+        probes.append(p)
+        return p
+
+    prev = set_scorer_decorator(deco)
+    try:
+        e = engine_cls(cfg)
+        for r in reads:
+            e.add_sequence(r)
+        result = e.consensus()
+    finally:
+        set_scorer_decorator(prev)
+    counts = collections.Counter()
+    for p in probes:
+        counts.update(p.probe_counts)
+    return result, counts
+
+
+def _single_reads(seq_len, n=6, seed=0):
+    _, reads = generate_test(4, seq_len, n, 0.01, seed=seed)
+    return list(reads)
+
+
+def _dual_reads(seq_len, half=4, seed=0):
+    truth, reads1 = generate_test(4, seq_len, half, 0.01, seed=seed)
+    h2 = bytearray(truth)
+    rng = np.random.default_rng(seed + 7)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    return list(reads1) + [
+        corrupt(bytes(h2), 0.01, np.random.default_rng(seed + 50 + i))
+        for i in range(half)
+    ]
+
+
+@pytest.mark.parametrize(
+    "engine_cls,maker",
+    [(ConsensusDWFA, _single_reads), (DualConsensusDWFA, _dual_reads)],
+    ids=["single", "dual"],
+)
+def test_per_pop_dispatch_does_constant_proxy_probes(engine_cls, maker):
+    """O(1) regression: growing the workload ~6x (hence the pop count)
+    must NOT grow the number of fast-path resolutions through the proxy
+    stack — the per-search probe count is a small constant."""
+    small_res, small_counts = _run_probed(engine_cls, maker(60), _cfg())
+    large_res, large_counts = _run_probed(engine_cls, maker(380), _cfg())
+    assert small_res and large_res  # both searches actually completed
+    assert large_counts == small_counts
+    assert large_counts, "probe saw no fast-path resolutions at all"
+    assert max(large_counts.values()) <= 4, dict(large_counts)
+
+
+def test_probe_decorator_is_transparent():
+    """The counting proxy itself must not perturb results: probed and
+    unprobed runs of the same workload are byte-identical."""
+    reads = _single_reads(150, seed=3)
+    probed, _ = _run_probed(ConsensusDWFA, reads, _cfg())
+    e = ConsensusDWFA(_cfg())
+    for r in reads:
+        e.add_sequence(r)
+    plain = e.consensus()
+    assert [(c.sequence, c.scores) for c in probed] == [
+        (c.sequence, c.scores) for c in plain
+    ]
+
+
+def test_fastpath_cache_hit_and_gen_invalidation():
+    """fast_paths() returns the SAME snapshot while ``fastpath_gen`` is
+    stable and a fresh one after a supervised demotion bumps it."""
+    cfg = _cfg(backend_chain=("python", "jax"))
+    reads = [bytes([0, 1, 2, 3] * 4)] * 3
+    sup = BackendSupervisor(reads, cfg)
+    fp1 = fast_paths(sup)
+    assert fast_paths(sup) is fp1  # cache hit while gen is stable
+    gen0 = sup.fastpath_gen
+    sup._demote(RuntimeError("injected demotion"))
+    assert sup.fastpath_gen == gen0 + 1
+    fp2 = fast_paths(sup)
+    assert fp2 is not fp1
+    assert fp2.gen == sup.fastpath_gen
+    assert fast_paths(sup) is fp2

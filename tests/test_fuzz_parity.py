@@ -215,6 +215,110 @@ def test_single_engine_wildcard_fuzz(seed):
 
 
 @pytest.mark.parametrize("seed", range(4))
+def test_reached_end_absorption_fuzz(seed):
+    """Staggered exact-prefix reads reach the end of their baseline at
+    different steps mid-run, so the lean device step's fused reached-end
+    absorption (folded into the vote count, no materialized occupancy
+    tensor) fires repeatedly against live votes from the full reads."""
+    rng = np.random.default_rng(17000 + seed)
+    seq_len = int(rng.integers(120, 260))
+    truth, reads = generate_test(4, seq_len, 5, 0.01, seed=18000 + seed)
+    reads = list(reads)
+    for frac in (0.3, 0.5, 0.7, 0.9):
+        cut = int(seq_len * frac) + int(rng.integers(0, 6))
+        reads.append(truth[:cut])
+    engines = []
+    for backend in ("python", "jax"):
+        e = ConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=3)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        engines.append(e)
+    want = engines[0].consensus()
+    got = engines[1].consensus()
+    assert [(c.sequence, c.scores) for c in want] == [
+        (c.sequence, c.scores) for c in got
+    ]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_near_tie_vote_fuzz(seed):
+    """Exact 50/50 vote ties sitting at the min_count threshold: the
+    same positions are flipped in exactly half of otherwise error-free
+    reads, so the fused vote counting must break ties (VOTE_EPS
+    ordering) and gate the threshold identically to the oracle."""
+    rng = np.random.default_rng(19000 + seed)
+    seq_len = int(rng.integers(60, 180))
+    n = int(rng.choice([4, 6, 8]))
+    truth, reads = generate_test(4, seq_len, n, 0.0, seed=20000 + seed)
+    reads = [bytearray(r) for r in reads]
+    for pos in rng.choice(seq_len, size=3, replace=False):
+        alt = (truth[pos] + 1 + int(rng.integers(3))) % 4
+        for i in range(n // 2):
+            if pos < len(reads[i]):
+                reads[i][pos] = alt
+    reads = [bytes(r) for r in reads]
+    engines = []
+    for backend in ("python", "jax"):
+        e = ConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=n // 2)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        engines.append(e)
+    want = engines[0].consensus()
+    got = engines[1].consensus()
+    assert [(c.sequence, c.scores) for c in want] == [
+        (c.sequence, c.scores) for c in got
+    ]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_i16_band_state_fuzz(seed, monkeypatch):
+    """Forced int16 band-state narrowing (``WAFFLE_XLA_I16=1``, normally
+    TPU-only): the narrowed while-loop kernels must stay bit-identical
+    to the oracle on single AND dual workloads wherever the
+    ``_xla_i16_ok`` geometry bound admits narrowing."""
+    monkeypatch.setenv("WAFFLE_XLA_I16", "1")
+    rng = np.random.default_rng(21000 + seed)
+    seq_len = int(rng.integers(80, 220))
+    n = int(rng.integers(4, 8))
+    er = float(rng.choice([0.0, 0.01, 0.04]))
+    truth, reads = generate_test(4, seq_len, n, er, seed=22000 + seed)
+    engines = []
+    for backend in ("python", "jax"):
+        e = ConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=2)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        engines.append(e)
+    want = engines[0].consensus()
+    got = engines[1].consensus()
+    assert [(c.sequence, c.scores) for c in want] == [
+        (c.sequence, c.scores) for c in got
+    ]
+
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    dual_reads = list(reads) + [
+        corrupt(bytes(h2), er, np.random.default_rng(23000 + seed * 16 + i))
+        for i in range(n)
+    ]
+    dual_engines = []
+    for backend in ("python", "jax"):
+        e = DualConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=2)
+        )
+        for r in dual_reads:
+            e.add_sequence(r)
+        dual_engines.append(e)
+    assert dual_engines[0].consensus() == dual_engines[1].consensus()
+
+
+@pytest.mark.parametrize("seed", range(4))
 def test_priority_chain_fuzz(seed):
     """Two-level chains with a level-1 split: the priority engine's
     worklist + shared-scorer views against the oracle."""

@@ -32,6 +32,7 @@ from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
     WavefrontScorer,
+    fast_paths,
     make_scorer,
 )
 from waffle_con_tpu.utils.pqueue import PQueueTracker, SetPriorityQueue
@@ -503,7 +504,8 @@ class ConsensusDWFA:
             # capacity bookkeeping, so the run may start behind the
             # farthest frontier without replaying a step the real search
             # would have pruned.
-            run_extend = getattr(scorer, "run_extend", None)
+            fp = fast_paths(scorer)
+            run_extend = fp.run_extend
             reached_now = self._reached_end(node, cfg.allow_early_termination)
             force_sym = -1
             if run_extend is not None:
@@ -523,9 +525,9 @@ class ConsensusDWFA:
                         len(passing_now) == 1
                         or 2
                         <= len(passing_now)
-                        <= getattr(scorer, "ARENA_CRE_PER_EVENT", 0)
+                        <= fp.arena_cre_per_event
                     )
-                    and getattr(scorer, "run_arena", None) is not None
+                    and fp.run_arena is not None
                 ):
                     arena = self._arena_attempt(
                         scorer, pqueue, node, maximum_error,
@@ -779,8 +781,9 @@ class ConsensusDWFA:
         cfg = self.config
         if pqueue.is_empty():
             return None  # no competitor: the plain run path is strictly better
+        fp = fast_paths(scorer)
         taken = []
-        take_max = getattr(scorer, "ARENA_TAKE_MAX", scorer.ARENA_K - 1)
+        take_max = fp.arena_take_max
         while len(taken) < take_max and not pqueue.is_empty():
             taken.append(pqueue.pop_with_seq())
         nodes = [node] + [t[0] for t in taken]
@@ -789,7 +792,7 @@ class ConsensusDWFA:
             for cand, pri, seq in taken:
                 pqueue.push_restored(cand.key(), cand, pri, seq)
 
-        step_limit = scorer.ARENA_CAP
+        step_limit = fp.arena_cap
         for nd in nodes:
             nl = len(nd.consensus)
             next_act = min((l for l in activate_points if l > nl), default=None)
@@ -811,7 +814,7 @@ class ConsensusDWFA:
                 max(len(nd.consensus) for nd in nodes),
                 farthest_consensus,
             )
-            + scorer.ARENA_CAP
+            + fp.arena_cap
             + 4
         )
         win_len = 1 << (needed - 1).bit_length()
@@ -828,7 +831,7 @@ class ConsensusDWFA:
             int(maximum_error) if maximum_error != math.inf else 2**31 - 1
         )
         (events, nsteps, _code, _stop_node, node_steps, appended,
-         sides_stats, _sides_act, alive, creations) = scorer.run_arena(
+         sides_stats, _sides_act, alive, creations) = fp.run_arena(
             [(nd.handle, None, len(nd.consensus), 0) for nd in nodes],
             me_budget,
             cfg.min_count,
@@ -939,7 +942,7 @@ class ConsensusDWFA:
         consumed and freed in this same iteration (never valid for peers,
         whose pristine state is still needed at their own pop)."""
         per_node_passing = [self._nominate(scorer, n) for n in nodes]
-        clone_push = getattr(scorer, "clone_push_many", None)
+        clone_push = fast_paths(scorer).clone_push_many
         if clone_push is not None:
             specs: List[Tuple[int, bytes, bool]] = []
             slots: List[List] = []

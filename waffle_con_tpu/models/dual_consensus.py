@@ -35,6 +35,7 @@ from waffle_con_tpu.models.consensus import (
 )
 from waffle_con_tpu.ops.scorer import (
     WavefrontScorer,
+    fast_paths,
     make_scorer,
 )
 from waffle_con_tpu.utils.pqueue import PQueueTracker, SetPriorityQueue
@@ -548,22 +549,23 @@ class DualConsensusDWFA:
             #: nodes engage the plain runs; only the arena (no record
             #: support) skips them
             reached_now = node.reached_all_end(cfg.allow_early_termination)
+            fp = fast_paths(scorer)
             kernels_ok = (
                 cfg.min_af == 0.0 or not cfg.weighted_by_ed
             ) and (
                 (
                     node.is_dual
                     and lockable
-                    and getattr(scorer, "run_extend_dual", None) is not None
+                    and fp.run_extend_dual is not None
                 )
                 or (
                     not node.is_dual
-                    and getattr(scorer, "run_extend", None) is not None
+                    and fp.run_extend is not None
                 )
             )
             runnable = False
             arena_shape = False
-            cre_cap = getattr(scorer, "ARENA_CRE_PER_EVENT", 0)
+            cre_cap = fp.arena_cre_per_event
 
             def kernel_exact(nd):
                 """Host mirror of the kernel's split-absorption vote
@@ -649,7 +651,7 @@ class DualConsensusDWFA:
                 arena_shape
                 and not reached_now
                 and not (node.is_dual and (node.lock1 or node.lock2))
-                and getattr(scorer, "run_arena", None) is not None
+                and fp.run_arena is not None
             ):
                 arena = self._arena_attempt(
                     scorer, pqueue, node, top_cost, maximum_error,
@@ -718,7 +720,7 @@ class DualConsensusDWFA:
                                 act1,
                                 act2,
                                 dual_records,
-                            ) = scorer.run_extend_dual(
+                            ) = fp.run_extend_dual(
                                 node.h1,
                                 node.h2,
                                 node.consensus1,
@@ -766,7 +768,7 @@ class DualConsensusDWFA:
                                     )
                         else:
                             (steps, _code, app1, stats1,
-                             run_records) = scorer.run_extend(
+                             run_records) = fp.run_extend(
                                 node.h1,
                                 node.consensus1,
                                 me_budget,
@@ -981,8 +983,9 @@ class DualConsensusDWFA:
 
         # collect the next-best compatible competitors, in pop order; the
         # first ineligible entry becomes the arena's rest-of-queue bound
+        fp = fast_paths(scorer)
         taken = []
-        take_max = getattr(scorer, "ARENA_TAKE_MAX", scorer.ARENA_K - 1)
+        take_max = fp.arena_take_max
         while len(taken) < take_max and not pqueue.is_empty():
             cand, pri, seq = pqueue.pop_with_seq()
             if cand.is_dual and (cand.lock1 or cand.lock2):
@@ -997,7 +1000,7 @@ class DualConsensusDWFA:
                 pqueue.push_restored(cand.key(), cand, pri, seq)
 
         nodes = [node] + [t[0] for t in taken]
-        step_limit = scorer.ARENA_CAP
+        step_limit = fp.arena_cap
         for nd in nodes:
             nl = nd.max_consensus_length()
             next_act = min((l for l in activate_points if l > nl), default=None)
@@ -1020,7 +1023,7 @@ class DualConsensusDWFA:
                 farthest_single,
                 farthest_dual,
             )
-            + scorer.ARENA_CAP
+            + fp.arena_cap
             + 4
         )
         win_len = 1 << (needed - 1).bit_length()
@@ -1040,7 +1043,7 @@ class DualConsensusDWFA:
             int(maximum_error) if maximum_error != math.inf else 2**31 - 1
         )
         (events, nsteps, _code, _stop_node, node_steps, appended,
-         sides_stats, sides_act, alive, creations) = scorer.run_arena(
+         sides_stats, sides_act, alive, creations) = fp.run_arena(
             [
                 (
                     nd.h1,
@@ -1407,7 +1410,7 @@ class DualConsensusDWFA:
         dispatch and ONE fused push dispatch across all of them, storing
         ``(specs, children)`` on each node's ``prefetch``."""
         per_node_specs = [self._build_specs(scorer, node) for node in nodes]
-        clone_push = getattr(scorer, "clone_push_many", None)
+        clone_push = fast_paths(scorer).clone_push_many
 
         #: fused-path bookkeeping: (src_handle, consensus|None) per cloned
         #: side, plus where to deliver the resulting (handle, stats)

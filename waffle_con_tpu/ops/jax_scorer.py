@@ -83,7 +83,20 @@ VOTE_EPS = np.float32(1e-2)
 #: records than this stops with code 2 and the host continues normally
 REC_CAP = 256
 
+#: int16 band-state "infinity" (mirrors ``pallas_run.DINF16``): large
+#: enough that no reachable finite cell cost can touch it under the
+#: ``_xla_i16_ok`` geometry bound, small enough that ``DINF16 + 1`` (a
+#: deletion out of an invalid cell) cannot wrap int16.
+DINF16 = np.int32(30000)
+
 logger = logging.getLogger(__name__)
+
+
+def _xla_i16_ok(L: int, C: int, W: int) -> bool:
+    """True when every finite cell cost the banded DP can produce fits
+    strictly under :data:`DINF16` (same bound as ``pallas_run.i16_ok``),
+    so narrowing ``D`` to int16 is value-exact."""
+    return max(L, C) + W + 4 < int(DINF16)
 
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
@@ -122,29 +135,90 @@ def _read_window(reads_pad, start, R, W):
     )
 
 
+#: block width of the two-level prefix-min scan; 8 measured fastest on
+#: CPU at band shapes (the scan is memory-bound: 3 masked shift passes
+#: plus one carry combine beat the 10-pass log-shift lowering)
+_CUMMIN_BLOCK = 8
+
+
+def _cummin_rows(x):
+    """Exact row-wise prefix min (``lax.cummin(x, axis=1)``) via a
+    two-level masked-shift scan: per-block local prefix mins (shift-min
+    passes that never cross block boundaries), a tiny prefix min over the
+    per-block tails, then one combine pass.  Roughly halves the memory
+    passes of the stock log-shift lowering on CPU; other backends keep
+    the stock scan (XLA:TPU lowers ``cummin`` through its own blocked
+    reduce-window path already)."""
+    if jax.default_backend() != "cpu":
+        return lax.cummin(x, axis=1)
+    R, W = x.shape
+    G = _CUMMIN_BLOCK
+    if W <= 2 * G:
+        return lax.cummin(x, axis=1)
+    t = jnp.arange(W, dtype=jnp.int32)
+    within = t[None, :] % G
+    blk = t // G
+    nb = (W + G - 1) // G
+    big = jnp.iinfo(x.dtype).max
+    y = x
+    k = 1
+    while k < G:
+        shifted = jnp.concatenate(
+            [jnp.full((R, k), big, x.dtype), y[:, :-k]], axis=1
+        )
+        y = jnp.where(within >= k, jnp.minimum(y, shifted), y)
+        k *= 2
+    tails = y[:, G - 1 :: G]
+    if tails.shape[1] < nb:  # partial last block: its tail is column W-1
+        tails = jnp.concatenate([tails, y[:, -1:]], axis=1)
+    carry = lax.cummin(tails, axis=1)
+    cprev = jnp.take(carry, jnp.maximum(blk - 1, 0), axis=1)
+    return jnp.where(blk[None, :] == 0, y, jnp.minimum(y, cprev))
+
+
 def _col_step_w(D, e, rmin, er, off, act, rlen, bchar, jnew, sym, wc, et, E):
     """Advance one branch's banded columns from ``jnew-1`` to ``jnew`` by
     consuming consensus symbol ``sym``, with the read window ``bchar``
     (``bchar[r, t] == reads[r, i_new - 1]`` wherever ``i_new`` is in
     range) already fetched; returns updated (D, e, rmin, er) with
-    inactive reads passed through unchanged."""
+    inactive reads passed through unchanged.
+
+    Dtype-polymorphic over ``D``: with int16 band state (the narrowed
+    path gated by :func:`_xla_i16_ok`) the invalid sentinel is
+    :data:`DINF16` instead of :data:`INF` and all column arithmetic
+    stays int16 — value-exact because the geometry bound keeps every
+    finite cell strictly under the sentinel.  The per-read running folds
+    (``e``/``rmin``/``er``) always stay int32: they latch ``INF``."""
     R, W = D.shape
+    dt = D.dtype
+    narrowed = dt != jnp.int32
+    big = jnp.asarray(DINF16 if narrowed else INF, dt)
     t = jnp.arange(W, dtype=jnp.int32)[None, :]
     i_new = jnew - off[:, None] - E + t
 
-    sub = ((bchar != sym) & (bchar != wc)).astype(jnp.int32)
+    sub = ((bchar != sym) & (bchar != wc)).astype(dt)
 
     diag = D + sub
-    dele = jnp.concatenate([D[:, 1:], jnp.full_like(D[:, :1], INF)], axis=1) + 1
+    dele = (
+        jnp.concatenate([D[:, 1:], jnp.full_like(D[:, :1], big)], axis=1)
+        + jnp.asarray(1, dt)
+    )
     base = jnp.minimum(diag, dele)
     invalid = (i_new < 0) | (i_new > rlen[:, None])
-    base = jnp.where(invalid, INF, base)
+    base = jnp.where(invalid, big, base)
     # insertion chain within the column: prefix-min of (base - t) + t
-    chain = lax.cummin(base - t, axis=1)
-    Dn = jnp.minimum(jnp.minimum(base, chain + t), INF)
+    tt = t.astype(dt)
+    chain = _cummin_rows(base - tt)
+    Dn = jnp.minimum(jnp.minimum(base, chain + tt), big)
 
-    colmin = Dn.min(axis=1)
-    rend = jnp.where(i_new == rlen[:, None], Dn, INF).min(axis=1)
+    colmin = Dn.min(axis=1).astype(jnp.int32)
+    rend = (
+        jnp.where(i_new == rlen[:, None], Dn, big).min(axis=1)
+        .astype(jnp.int32)
+    )
+    if narrowed:  # restore the INF sentinel for the int32 latch folds
+        colmin = jnp.where(colmin >= DINF16, INF, colmin)
+        rend = jnp.where(rend >= DINF16, INF, rend)
     rmin_n = jnp.minimum(rmin, rend)
     e_uncapped = jnp.maximum(e, colmin)
     e_capped = jnp.where(
@@ -190,26 +264,72 @@ def _col_step_u(
     )
 
 
-def _stats_core_w(D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E):
+def _stats_core_w(
+    D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E,
+    a_real=None, pad=True,
+):
     """Snapshot of one branch: per-read edit distance, tip votes over dense
     symbols, reached flags (reference overshoot semantics).  ``vchar`` is
     the read window at the tip column (``vchar[r, t] == reads[r, i]``
-    wherever ``i`` is in range)."""
+    wherever ``i`` is in range).
+
+    ``a_real`` (static) bounds the one-hot vote fold to the engine's real
+    dense alphabet: reads only ever hold ids below it, so the occupancy
+    columns in ``[a_real, num_symbols)`` are structurally zero — skipping
+    them halves the ``[R, W, A]`` reduce for a 4-symbol alphabet padded
+    to the shared ``A = 8`` shape.  With ``pad`` the result is
+    zero-padded back to ``[R, num_symbols]`` (the host-visible stats
+    shape); the run loops pass ``pad=False`` and vote at ``a_real``."""
     R, W = D.shape
+    ar = num_symbols if a_real is None else min(a_real, num_symbols)
     t = jnp.arange(W, dtype=jnp.int32)[None, :]
     i = clen - off[:, None] - E + t
-    tip = act[:, None] & (D <= e[:, None]) & (i >= 0) & (i < rlen[:, None])
-    onehot = (vchar[:, :, None] == jnp.arange(num_symbols)[None, None, :]) & tip[
-        :, :, None
-    ]
-    occ = onehot.sum(axis=1, dtype=jnp.int32)
+    # with int16 band state the tip compare stays int16 (e is clamped to
+    # the sentinel, which only engages on dead lanes where D == DINF16
+    # matches the widened compare anyway)
+    e_c = (
+        e[:, None]
+        if D.dtype == jnp.int32
+        else jnp.minimum(e, DINF16)[:, None].astype(D.dtype)
+    )
+    tip = act[:, None] & (D <= e_c) & (i >= 0) & (i < rlen[:, None])
+    if jax.default_backend() == "cpu" and W < (1 << 15):
+        # bit-packed occupancy: two 15-bit per-symbol counters per int32
+        # lane, one [R, W] fused select+reduce per symbol PAIR — ~6x
+        # cheaper than the [R, W, A] one-hot reduce on CPU (counts are
+        # bounded by W < 2^15, so the fields cannot carry).  Non-tip
+        # lanes contribute nothing regardless of their window bytes
+        # (pad bytes are -1: ``-1 >> 1 == -1`` never matches a pair id).
+        w32 = vchar.astype(jnp.int32)
+        accs = [
+            jnp.where(
+                tip & ((w32 >> 1) == k),
+                jnp.int32(1) << (15 * (w32 & 1)),
+                0,
+            ).sum(axis=1)
+            for k in range((ar + 1) // 2)
+        ]
+        occ = jnp.stack(
+            [(accs[a // 2] >> (15 * (a & 1))) & 0x7FFF for a in range(ar)],
+            axis=1,
+        )
+    else:
+        onehot = (
+            vchar[:, :, None] == jnp.arange(ar)[None, None, :]
+        ) & tip[:, :, None]
+        occ = onehot.sum(axis=1, dtype=jnp.int32)
     split = occ.sum(axis=1)
+    if pad and ar < num_symbols:
+        occ = jnp.pad(occ, ((0, 0), (0, num_symbols - ar)))
     reached = act & (er < INF) & (e == er)
     eds = jnp.where(act, e, 0)
     return eds, occ, split, reached
 
 
-def _stats_core(D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E):
+def _stats_core(
+    D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E,
+    a_real=None, pad=True,
+):
     """Gather-sourced :func:`_stats_core_w` (general offsets path)."""
     W = D.shape[1]
     L = reads.shape[1]
@@ -217,18 +337,21 @@ def _stats_core(D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E):
     i = clen - off[:, None] - E + t
     vchar = jnp.take_along_axis(reads, jnp.clip(i, 0, L - 1), axis=1)
     return _stats_core_w(
-        D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E
+        D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E,
+        a_real=a_real, pad=pad,
     )
 
 
 def _stats_core_u(
-    D, e, rmin, er, off, act, rlen, reads_pad, clen, off0, num_symbols, E
+    D, e, rmin, er, off, act, rlen, reads_pad, clen, off0, num_symbols, E,
+    a_real=None, pad=True,
 ):
     """Slice-sourced :func:`_stats_core_w` (uniform active offsets)."""
     R, W = D.shape
     vchar = _read_window(reads_pad, W + clen - off0 - E, R, W)
     return _stats_core_w(
-        D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E
+        D, e, rmin, er, off, act, rlen, vchar, clen, num_symbols, E,
+        a_real=a_real, pad=pad,
     )
 
 
@@ -610,10 +733,12 @@ def _nominate_side(occ, split, w, wc, weighted, mc_tab, mc_dyn):
 
 
 @partial(
-    jax.jit, static_argnames=("num_symbols", "uniform"), donate_argnums=(0,)
+    jax.jit,
+    static_argnames=("num_symbols", "uniform", "a_real", "i16"),
+    donate_argnums=(0,),
 )
 def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
-           uniform):
+           uniform, a_real=None, i16=False):
     """Device-resident multi-symbol extension: keep appending the unique
     passing candidate while the votes are exactly reproducible host-side
     (one tip symbol per read → integer counts), stopping at any event the
@@ -677,6 +802,14 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     disabled and reached states stop with code 2 as before.  Returns
     ``(state, steps, code, stats, cons, fin_eds, fin_ovf, rec_count,
     rec_steps, rec_fins)``.
+
+    ``a_real`` (static) is the engine's real dense alphabet size: the
+    per-step vote fold runs at that width instead of the padded
+    ``num_symbols`` shape (only the FINAL host-visible stats snapshot is
+    padded back).  ``i16`` (static, see :func:`_xla_i16_ok`) narrows the
+    band state to int16 for the whole loop — converted once at loop
+    entry/exit, never per step — halving the hot ``[R, W]`` traffic.
+    Both are value-exact: results are bit-identical to the wide path.
     """
     h = params[0]
     me_budget = params[1]
@@ -693,14 +826,17 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     off = state["off"][h]
     act = state["act"][h]
 
-    def stats_at(D, e, rmin, er, clen):
+    av = num_symbols if a_real is None else min(a_real, num_symbols)
+
+    def stats_at(D, e, rmin, er, clen, pad=True):
         if uniform:
             return _stats_core_u(
                 D, e, rmin, er, off, act, rlen, reads_pad, clen, off0,
-                num_symbols, E,
+                num_symbols, E, a_real=a_real, pad=pad,
             )
         return _stats_core(
-            D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
+            D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E,
+            a_real=a_real, pad=pad,
         )
 
     def col_at(D, e, rmin, er, jnew, sym):
@@ -716,7 +852,7 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     def body(carry):
         (D, e, rmin, er, cons, clen, steps, budget,
          rec_count, rec_steps, rec_fins, _code) = carry
-        eds, occ, split, reached = stats_at(D, e, rmin, er, clen)
+        eds, occ, split, reached = stats_at(D, e, rmin, er, clen, pad=False)
         # finalized snapshot of THIS (pre-push) state: the host records it
         # at this pop; absorbing the record needs it in-band.  Inlined
         # ``_finalized`` so its folds ride the packed reductions below.
@@ -831,8 +967,13 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         # (a stopped state is recorded by the host's own completion path)
         do_rec = commit & reached_here
         ri = jnp.clip(rec_count, 0, REC_CAP - 1)
-        rec_steps = jnp.where(do_rec, rec_steps.at[ri].set(steps), rec_steps)
-        rec_fins = jnp.where(do_rec, rec_fins.at[ri].set(fin_j), rec_fins)
+        # row-scatter (select inside the updated row) instead of a
+        # whole-buffer select: the [REC_CAP, R] plane stays out of the
+        # per-step write set on non-record steps
+        rec_steps = rec_steps.at[ri].set(
+            jnp.where(do_rec, steps, rec_steps[ri])
+        )
+        rec_fins = rec_fins.at[ri].set(jnp.where(do_rec, fin_j, rec_fins[ri]))
         rec_count = rec_count + do_rec.astype(jnp.int32)
         # accepted records shrink the running budget exactly as the host
         # does (strictly-better totals only; appends don't change it)
@@ -850,6 +991,10 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
                 rec_count, rec_steps, rec_fins, code)
 
     D0 = state["D"][h]
+    if i16:
+        # narrow ONCE for the whole loop: finite cells are exact under
+        # the _xla_i16_ok bound, INF clamps to the DINF16 sentinel
+        D0 = jnp.minimum(D0, DINF16).astype(jnp.int16)
     e0 = state["e"][h]
     rmin0 = state["rmin"][h]
     er0 = state["er"][h]
@@ -895,6 +1040,9 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
      rec_count, rec_steps, rec_fins, code) = lax.while_loop(
         lambda c: c[11] == 0, body, init
     )
+    if i16:  # widen back, restoring the INF sentinel
+        Dw = D.astype(jnp.int32)
+        D = jnp.where(Dw >= DINF16, INF, Dw)
     stats = stats_at(D, e, rmin, er, clen)
     fin_eds, fin_ovf = _finalized(e, rmin, act, E)
     out = dict(state)
@@ -943,10 +1091,12 @@ def _dual_votes(occ, split, w, wc, weighted):
 
 
 @partial(
-    jax.jit, static_argnames=("num_symbols", "uniform"), donate_argnums=(0,)
+    jax.jit,
+    static_argnames=("num_symbols", "uniform", "a_real", "i16"),
+    donate_argnums=(0,),
 )
 def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
-                wc, et, num_symbols, uniform):
+                wc, et, num_symbols, uniform, a_real=None, i16=False):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
     with divergence pruning (``dual_max_ed_delta``) applied on device
@@ -1027,14 +1177,15 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
     offb = state["off"][hb]
     IMBN = imb_tab.shape[0]
 
-    def stats_at(D, e, rmin, er, off, act, clen, off0):
+    def stats_at(D, e, rmin, er, off, act, clen, off0, pad=True):
         if uniform:
             return _stats_core_u(
                 D, e, rmin, er, off, act, rlen, reads_pad, clen, off0,
-                num_symbols, E,
+                num_symbols, E, a_real=a_real, pad=pad,
             )
         return _stats_core(
-            D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E
+            D, e, rmin, er, off, act, rlen, reads, clen, num_symbols, E,
+            a_real=a_real, pad=pad,
         )
 
     def col_at(D, e, rmin, er, off, act, jnew, off0, sym):
@@ -1054,10 +1205,10 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
          _code) = carry
 
         edsa, occa, splita, reacheda = stats_at(
-            Da, ea, rmina, era, offa, acta, clena, off0a
+            Da, ea, rmina, era, offa, acta, clena, off0a, pad=False
         )
         edsb, occb, splitb, reachedb = stats_at(
-            Db, eb, rminb, erb, offb, actb, clenb, off0b
+            Db, eb, rminb, erb, offb, actb, clenb, off0b, pad=False
         )
 
         # total node cost = per read, best over its tracked sides
@@ -1213,11 +1364,16 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
         # running budget exactly as an accepted record would
         do_rec = commit & reached_stop
         ri = jnp.clip(rec_count, 0, REC_CAP - 1)
-        rec_steps = jnp.where(do_rec, rec_steps.at[ri].set(steps), rec_steps)
-        rec_f1 = jnp.where(do_rec, rec_f1.at[ri].set(fin1_j), rec_f1)
-        rec_f2 = jnp.where(do_rec, rec_f2.at[ri].set(fin2_j), rec_f2)
-        rec_a1 = jnp.where(do_rec, rec_a1.at[ri].set(acta), rec_a1)
-        rec_a2 = jnp.where(do_rec, rec_a2.at[ri].set(actb), rec_a2)
+        # row-scatter (select inside the updated row): keeps the five
+        # [REC_CAP, R] planes out of the per-step write set
+        rsel = lambda buf, new: buf.at[ri].set(  # noqa: E731
+            jnp.where(do_rec, new, buf[ri])
+        )
+        rec_steps = rsel(rec_steps, steps)
+        rec_f1 = rsel(rec_f1, fin1_j)
+        rec_f2 = rsel(rec_f2, fin2_j)
+        rec_a1 = rsel(rec_a1, acta)
+        rec_a2 = rsel(rec_a2, actb)
         rec_count = rec_count + do_rec.astype(jnp.int32)
         budget = jnp.where(
             do_rec & ~rec_imbalanced & (fin_total < budget),
@@ -1246,10 +1402,15 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
                 code)
 
     R = rlen.shape[0]
+    Da0 = state["D"][ha]
+    Db0 = state["D"][hb]
+    if i16:  # narrow once for the whole loop (see _j_run)
+        Da0 = jnp.minimum(Da0, DINF16).astype(jnp.int16)
+        Db0 = jnp.minimum(Db0, DINF16).astype(jnp.int16)
     init = (
-        state["D"][ha], state["e"][ha], state["rmin"][ha], state["er"][ha],
+        Da0, state["e"][ha], state["rmin"][ha], state["er"][ha],
         state["act"][ha], state["cons"][ha], state["clen"][ha],
-        state["D"][hb], state["e"][hb], state["rmin"][hb], state["er"][hb],
+        Db0, state["e"][hb], state["rmin"][hb], state["er"][hb],
         state["act"][hb], state["cons"][hb], state["clen"][hb],
         jnp.int32(0), me_budget,
         jnp.int32(0),
@@ -1266,6 +1427,11 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
      code) = lax.while_loop(
         lambda c: c[22] == 0, body, init
     )
+    if i16:  # widen back, restoring the INF sentinel
+        Daw = Da.astype(jnp.int32)
+        Da = jnp.where(Daw >= DINF16, INF, Daw)
+        Dbw = Db.astype(jnp.int32)
+        Db = jnp.where(Dbw >= DINF16, INF, Dbw)
     stats_a = stats_at(Da, ea, rmina, era, offa, acta, clena, off0a)
     stats_b = stats_at(Db, eb, rminb, erb, offb, actb, clenb, off0b)
     out = dict(state)
@@ -1291,13 +1457,13 @@ CRE_PER_EVENT = 8
 
 @partial(
     jax.jit,
-    static_argnames=("num_symbols", "max_steps", "K", "uniform"),
+    static_argnames=("num_symbols", "max_steps", "K", "uniform", "a_real"),
     donate_argnums=(0,),
 )
 def _j_arena(
     state, reads, reads_pad, rlen, params, slots, kinds0, seqv0, off0s0,
     tr_scalars, lc0, pc0, mc_tab, imb_tab, wc, et, num_symbols, max_steps,
-    K, uniform,
+    K, uniform, a_real=None,
 ):
     """K-node pop ARENA: resolve the pop competition among the K best
     runnable queue entries entirely on device.
@@ -1399,10 +1565,13 @@ def _j_arena(
     C = state["cons"].shape[1]
     Lw = lc0.shape[1]
     R = reads.shape[0]
-    A = num_symbols
+    # the whole pop/vote/creation pipeline runs at the REAL alphabet
+    # width (dense ids never reach the padded columns); only the final
+    # host-visible stats are padded back to the shared num_symbols shape
+    A = num_symbols if a_real is None else min(a_real, num_symbols)
     n_lim = n_live + n_pool          # nodes beyond this are pure scratch
 
-    def stats_all(D, e, rmin, er, offs, act, clen, off0s):
+    def stats_all(D, e, rmin, er, offs, act, clen, off0s, pad=True):
         """Per-side snapshots [2K, ...]; with ``uniform`` (static) the 2K
         read windows are unrolled ``dynamic_slice``s of ``reads_pad``
         (each side's active reads share offset ``off0s[side]``) instead
@@ -1418,14 +1587,14 @@ def _j_arena(
                 lambda D_, e_, rmin_, er_, off_, act_, vchar_, clen_: (
                     _stats_core_w(
                         D_, e_, rmin_, er_, off_, act_, rlen, vchar_,
-                        clen_, num_symbols, E,
+                        clen_, num_symbols, E, a_real=a_real, pad=pad,
                     )
                 )
             )(D, e, rmin, er, offs, act, vchars, clen)
         return jax.vmap(
             lambda D_, e_, rmin_, er_, off_, act_, clen_: _stats_core(
                 D_, e_, rmin_, er_, off_, act_, rlen, reads, clen_,
-                num_symbols, E,
+                num_symbols, E, a_real=a_real, pad=pad,
             )
         )(D, e, rmin, er, offs, act, clen)
 
@@ -1524,7 +1693,7 @@ def _j_arena(
 
         is_dual = kinds == 1
         eds, occ, split, reached = stats_all(
-            D, e, rmin, er, offs, act, clen, off0s
+            D, e, rmin, er, offs, act, clen, off0s, pad=False
         )
 
         (totals, lens, reach, dirty, sym1s, sym2s, imb, fin1s, fin2s,
@@ -2335,7 +2504,11 @@ class JaxScorer(WavefrontScorer):
         )
         #: per-kernel health (1 = single, 2 = dual): a compile failure
         #: disables only the failing kernel, not the whole fused path
-        self._pallas_kernel_ok = {1: True, 2: True}
+        # (sides, W, MS, i16) buckets individually disabled by a compile
+        # failure; absent keys mean the bucket is still eligible, so one
+        # huge-MS failure never disables the fused path for small
+        # geometries (and a band grow naturally re-enables probing).
+        self._pallas_kernel_ok = {}
         self._reads_T_cache = None
         self._stage_reads_pad()
         self._state = self._blank_state()
@@ -2668,18 +2841,31 @@ class JaxScorer(WavefrontScorer):
             self._state, np.asarray([hs, ridx], dtype=np.int32)
         )
 
-    def _pallas_ok(self, sides: int = 1) -> bool:
-        """Fused-kernel eligibility: mode on (and that kernel not
-        individually disabled by an earlier compile failure) + the
-        whole staging fits the VMEM budget at current geometry (with
-        the tile dtype the dispatch would actually use) + the occ
-        output rows cover the alphabet (the kernel emits a fixed 8-row
-        occ block) + the scorer is unsharded (pallas_call cannot
-        partition GSPMD-sharded operands; the mesh path keeps the XLA
-        while-loop kernels)."""
+    def _pallas_ms(self, max_steps: int) -> int:
+        """SMEM symbol-buffer bucket for a dispatch of ``max_steps``
+        (the pure half of :meth:`_pallas_prep`, shared so eligibility
+        and setup agree on the kernel-variant key)."""
+        return _next_pow2(min(max_steps, _PALLAS_MS_CAP - 2) + 2, 256)
+
+    def _pallas_geom(self, sides: int, ms: int):
+        """Kernel-variant bucket for the per-geometry disable map:
+        one Mosaic compile failure only disqualifies the (sides, band
+        width, symbol-buffer size, tile dtype) combination that
+        actually failed."""
+        return (sides, self._W, ms, self._pallas_i16())
+
+    def _pallas_ok(self, sides: int = 1, ms: int = 0) -> bool:
+        """Fused-kernel eligibility: mode on (and that kernel VARIANT —
+        see :meth:`_pallas_geom` — not individually disabled by an
+        earlier compile failure) + the whole staging fits the VMEM
+        budget at current geometry (with the tile dtype the dispatch
+        would actually use) + the occ output rows cover the alphabet
+        (the kernel emits a fixed 8-row occ block) + the scorer is
+        unsharded (pallas_call cannot partition GSPMD-sharded operands;
+        the mesh path keeps the XLA while-loop kernels)."""
         if self._pallas_mode == "off" or self._A > 8:
             return False
-        if not self._pallas_kernel_ok.get(sides, True):
+        if not self._pallas_kernel_ok.get(self._pallas_geom(sides, ms), True):
             return False
         if self._shardings is not None:
             return False
@@ -2698,38 +2884,68 @@ class JaxScorer(WavefrontScorer):
             and os.environ.get("WAFFLE_PALLAS_I16", "1") != "0"
         )
 
+    def _xla_i16(self) -> bool:
+        """int16 band-state narrowing for the XLA while-loop run kernels
+        (mirrors the pallas ``i16`` flag): on by default only where the
+        narrower tile wins — TPU, where the ``[R, W]`` loop is
+        memory-bound.  CPU XLA lowers the int16 column math slower than
+        int32, so it stays off there unless forced for parity testing
+        via ``WAFFLE_XLA_I16=1``.  The narrowed path is value-exact
+        whenever the :func:`_xla_i16_ok` geometry bound holds."""
+        env = os.environ.get("WAFFLE_XLA_I16")
+        if env == "0":
+            return False
+        if not _xla_i16_ok(self._L, self._C, self._W):
+            return False
+        return env == "1" or jax.default_backend() == "tpu"
+
     def _pallas_prep(self, longest: int, max_steps: int):
         """Shared pallas dispatch setup: bucket the SMEM symbol-buffer
         size, cap the per-dispatch steps (a capped run stops with code
         4 and the engine re-engages), grow the consensus axis to fit,
         and resolve the DP-tile dtype.  Returns (MS, capped_steps,
         i16)."""
-        MS = _next_pow2(min(max_steps, _PALLAS_MS_CAP - 2) + 2, 256)
+        MS = self._pallas_ms(max_steps)
         while longest + MS + 2 >= self._C:
             self._grow_cons()
         return MS, min(max_steps, MS - 2), self._pallas_i16()
 
-    def _pallas_guarded(self, sides: int, fn, *args):
+    def _pallas_guarded(self, sides: int, ms: int, fn, *args):
         """Run a fused-kernel wrapper, bumping its engagement counter;
         a Mosaic lowering/compile failure must never take the engine
-        down, so on exception the ONE failing kernel is disabled for
-        this scorer and ``None`` signals the caller to fall back to
-        the XLA while-loop path."""
+        down, so on exception the ONE failing kernel VARIANT (its
+        ``_pallas_geom`` bucket) is disabled for this scorer and
+        ``None`` signals the caller to fall back to the XLA while-loop
+        path.  The result is synced with ``block_until_ready`` INSIDE
+        the guard: a dispatch that fails asynchronously on device must
+        surface here, where the fallback still exists, not at a later
+        unrelated ``device_get``.  The one unrecoverable case — the
+        failed dispatch already consumed the donated state buffers — is
+        re-raised with intact context so the supervisor's retry/demote
+        machinery handles it instead of a confusing deferred crash."""
         key = "run_pallas_calls" if sides == 1 else "run_dual_pallas_calls"
+        geom = self._pallas_geom(sides, ms)
         try:
             from waffle_con_tpu.runtime import faults
 
             faults.check_pallas(sides)
             out = fn(*args)
+            jax.block_until_ready(out)
         except Exception:
             logger.warning(
-                "pallas kernel (sides=%d) failed; falling back to the "
-                "XLA path", sides, exc_info=True,
+                "pallas kernel (sides=%d, geom=%s) failed; falling back "
+                "to the XLA path", sides, geom, exc_info=True,
             )
-            self._pallas_kernel_ok[sides] = False
+            self._pallas_kernel_ok[geom] = False
             from waffle_con_tpu.runtime import events
 
-            events.record("pallas_kernel_disabled", sides=sides)
+            events.record("pallas_kernel_disabled", sides=sides, geom=geom)
+            state_lost = any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(self._state)
+            )
+            if state_lost:
+                raise
             return None
         self.counters[key] = self.counters.get(key, 0) + 1
         return out
@@ -2785,7 +3001,9 @@ class JaxScorer(WavefrontScorer):
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
         uniform, off0 = self._uniform_off(slot)
-        use_pallas = uniform and self._pallas_ok(sides=1)
+        use_pallas = uniform and self._pallas_ok(
+            sides=1, ms=self._pallas_ms(max_steps)
+        )
         if use_pallas:
             MS, max_steps, i16 = self._pallas_prep(
                 len(consensus), max_steps
@@ -2809,7 +3027,7 @@ class JaxScorer(WavefrontScorer):
             from waffle_con_tpu.ops.pallas_run import _j_run_pallas
 
             out = self._pallas_guarded(
-                1, _j_run_pallas,
+                1, MS, _j_run_pallas,
                 self._state, self._reads_T(), self._rlen, params,
                 self._wc, self._et, self._A, self.num_symbols, MS, i16,
                 self._pallas_mode == "interpret",
@@ -2824,6 +3042,7 @@ class JaxScorer(WavefrontScorer):
              rec_count, rec_steps, rec_fins) = _j_run(
                 self._state, self._reads, self._reads_pad, self._rlen,
                 params, self._wc, self._et, self._A, uniform,
+                a_real=self.num_symbols, i16=self._xla_i16(),
             )
         self._state = state
         with _obs_span("device_get:run_extend", "device-sync"):
@@ -2936,7 +3155,9 @@ class JaxScorer(WavefrontScorer):
             ],
             dtype=np.int32,
         )
-        use_pallas = (uni1 and uni2) and self._pallas_ok(sides=2)
+        use_pallas = (uni1 and uni2) and self._pallas_ok(
+            sides=2, ms=self._pallas_ms(max_steps)
+        )
         if use_pallas:
             from waffle_con_tpu.ops.pallas_run import _j_run_dual_pallas
 
@@ -2945,7 +3166,7 @@ class JaxScorer(WavefrontScorer):
             )
             params[10] = capped
             out = self._pallas_guarded(
-                2, _j_run_dual_pallas,
+                2, MS, _j_run_dual_pallas,
                 self._state, self._reads_T(), self._rlen, params,
                 np.ascontiguousarray(mc_tab, dtype=np.int32),
                 imb_tab, self._wc, self._et, self._A,
@@ -2965,6 +3186,7 @@ class JaxScorer(WavefrontScorer):
                 self._state, self._reads, self._reads_pad, self._rlen,
                 params, np.ascontiguousarray(mc_tab, dtype=np.int32),
                 imb_tab, self._wc, self._et, self._A, uni1 and uni2,
+                a_real=self.num_symbols, i16=self._xla_i16(),
             )
         self._state = state
         with _obs_span("device_get:run_extend_dual", "device-sync"):
@@ -3204,6 +3426,7 @@ class JaxScorer(WavefrontScorer):
                 self.ARENA_CAP,
                 K,
                 uniform,
+                a_real=self.num_symbols,
             )
         )
         self._state = state

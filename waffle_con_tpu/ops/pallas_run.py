@@ -575,8 +575,18 @@ def _j_run_pallas(
     fin_ovf = scalars[3].astype(bool)
     clen_f = scalars[4]
 
-    # caller guarantees clen0 + MS <= C, so the start never clamps
-    cons_row = lax.dynamic_update_slice(state["cons"][h], syms, (clen0,))
+    # caller guarantees clen0 + MS <= C, so the start never clamps.
+    # The kernel writes syms[k] only at committed steps, so entries
+    # beyond the committed count are UNINITIALIZED TPU memory — mask
+    # them back to the row's previous bytes before the splice, making
+    # the full cons row bit-identical to the XLA path (which only ever
+    # writes committed positions).
+    cons_prev = state["cons"][h]
+    prev_win = lax.dynamic_slice(cons_prev, (clen0,), (MS,))
+    syms = jnp.where(
+        jnp.arange(MS, dtype=jnp.int32) < (clen_f - clen0), syms, prev_win
+    )
+    cons_row = lax.dynamic_update_slice(cons_prev, syms, (clen0,))
     Dn32 = Dn.astype(jnp.int32)
     if i16:
         Dn32 = jnp.where(Dn32 >= DINF16, jnp.int32(INF), Dn32)
@@ -1067,12 +1077,24 @@ def _j_run_dual_pallas(
             D32 = jnp.where(D32 >= DINF16, jnp.int32(INF), D32)
         return D32.T
 
-    consa_row = lax.dynamic_update_slice(
-        state["cons"][ha], symsa, (clen0a,)
+    # symsa/symsb are written only at committed steps; entries past the
+    # committed count are uninitialized SMEM — mask them back to the
+    # previous cons bytes so the rows stay bit-identical to the XLA path.
+    ms_iota = jnp.arange(MS, dtype=jnp.int32)
+    consa_prev = state["cons"][ha]
+    consb_prev = state["cons"][hb]
+    symsa = jnp.where(
+        ms_iota < (clena_f - clen0a),
+        symsa,
+        lax.dynamic_slice(consa_prev, (clen0a,), (MS,)),
     )
-    consb_row = lax.dynamic_update_slice(
-        state["cons"][hb], symsb, (clen0b,)
+    symsb = jnp.where(
+        ms_iota < (clenb_f - clen0b),
+        symsb,
+        lax.dynamic_slice(consb_prev, (clen0b,), (MS,)),
     )
+    consa_row = lax.dynamic_update_slice(consa_prev, symsa, (clen0a,))
+    consb_row = lax.dynamic_update_slice(consb_prev, symsb, (clen0b,))
     acta_b = acta[0].astype(bool)
     actb_b = actb[0].astype(bool)
     out = dict(state)

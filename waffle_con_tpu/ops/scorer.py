@@ -349,6 +349,12 @@ class SubsetScorer(WavefrontScorer):
     def counters(self):
         return getattr(self.base, "counters", {})
 
+    @property
+    def fastpath_gen(self):
+        # forwarded so a supervised base's demotion invalidates any
+        # fast_paths() snapshot taken over this view (see fast_paths)
+        return getattr(self.base, "fastpath_gen", 0)
+
     def _slice(self, stats: BranchStats) -> BranchStats:
         idx = self.indices
         return BranchStats(
@@ -495,6 +501,64 @@ class SubsetScorer(WavefrontScorer):
             events, nsteps, code, stop_node, node_steps, appended,
             sides_stats, sides_act, alive, creations,
         )
+
+
+class FastPaths:
+    """The resolved optional-capability surface of a scorer: one probe
+    walk of the proxy stack (SubsetScorer / CoalescingScorer /
+    TimedScorer / BackendSupervisor all forward these dynamically),
+    snapshotted so the engines' per-pop feature tests don't re-walk it.
+
+    ``gen`` is the ``fastpath_gen`` the snapshot was taken at; see
+    :func:`fast_paths`.
+    """
+
+    __slots__ = (
+        "gen", "run_extend", "run_extend_dual", "run_arena",
+        "clone_push_many", "arena_cap", "arena_k", "arena_cre_per_event",
+        "arena_take_max",
+    )
+
+    def __init__(self, scorer, gen: int) -> None:
+        self.gen = gen
+        self.run_extend = getattr(scorer, "run_extend", None)
+        self.run_extend_dual = getattr(scorer, "run_extend_dual", None)
+        self.run_arena = getattr(scorer, "run_arena", None)
+        self.clone_push_many = getattr(scorer, "clone_push_many", None)
+        self.arena_cap = getattr(scorer, "ARENA_CAP", 0)
+        self.arena_k = getattr(scorer, "ARENA_K", 1)
+        self.arena_cre_per_event = getattr(scorer, "ARENA_CRE_PER_EVENT", 0)
+        self.arena_take_max = getattr(
+            scorer, "ARENA_TAKE_MAX", self.arena_k - 1
+        )
+
+
+def fast_paths(scorer) -> FastPaths:
+    """Cached :class:`FastPaths` for ``scorer``, re-resolved only when
+    its ``fastpath_gen`` changes.
+
+    The engines feature-test the device fast paths on EVERY pop; on the
+    full proxy stack each ``getattr`` walks several ``__getattr__`` /
+    property hops and binds fresh methods, which at hot-loop pop rates
+    is measurable host overhead.  The resolved surface is stable —
+    proxies forward dynamically only so a supervised base swapping
+    backends stays visible — so it is cached on the scorer instance and
+    keyed by the supervisor's demotion/promotion generation counter
+    (``fastpath_gen``, 0 for unsupervised stacks, forwarded by every
+    proxy).  The cache lives in the instance ``__dict__`` directly:
+    delegating proxies would otherwise serve the INNER scorer's cache
+    through their catch-all ``__getattr__``.
+    """
+    gen = getattr(scorer, "fastpath_gen", 0)
+    d = getattr(scorer, "__dict__", None)
+    if d is not None:
+        cached = d.get("_fastpath_cache")
+        if cached is not None and cached.gen == gen:
+            return cached
+    fp = FastPaths(scorer, gen)
+    if d is not None:
+        d["_fastpath_cache"] = fp
+    return fp
 
 
 def construct_backend(

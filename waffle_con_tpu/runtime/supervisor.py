@@ -123,6 +123,10 @@ class BackendSupervisor(WavefrontScorer):
         self._successes_since_demotion = 0
         self._probe_interval = config.repromote_after
         self._executor: Optional[ThreadPoolExecutor] = None
+        #: demotion/promotion generation: bumped on every backend swap so
+        #: engine-side ``fast_paths()`` snapshots over this scorer (or a
+        #: proxy view of it) re-resolve instead of going stale
+        self.fastpath_gen = 0
 
         self._pos = None
         last_exc: Optional[Exception] = None
@@ -224,6 +228,7 @@ class BackendSupervisor(WavefrontScorer):
             old = self.backend
             self._pos = next_pos
             self._scorer = scorer
+            self.fastpath_gen += 1
             self._consecutive_failures = 0
             self._successes_since_demotion = 0
             self._probe_interval = self.config.repromote_after
@@ -278,6 +283,7 @@ class BackendSupervisor(WavefrontScorer):
         old = self.backend
         self._pos = target_pos
         self._scorer = scorer
+        self.fastpath_gen += 1
         self._probe_interval = self.config.repromote_after
         events.record(
             "backend_promoted", from_backend=old, to_backend=target,

@@ -79,6 +79,12 @@ def _cache_entries(path: str):
         full = os.path.join(path, name)
         if name == MANIFEST_NAME or name.startswith("."):
             continue
+        # JAX's LRU eviction keeps an 8-byte ``<key>-atime`` sidecar per
+        # entry and REWRITES it on every cache hit; it carries no machine
+        # code, so sealing it would quarantine every sidecar on every
+        # warm run (observed: ~28 spurious quarantine events per bench)
+        if name.endswith("-atime"):
+            continue
         if os.path.isfile(full):
             yield name, full
 
@@ -137,9 +143,12 @@ def quarantine_corrupt_entries(path: str) -> list:
             from waffle_con_tpu.runtime import events
 
             events.record("cache_quarantine", entry=name)
-    # drop manifest rows whose entries vanished (evicted externally)
+    # drop manifest rows whose entries vanished (evicted externally) and
+    # rows for ``-atime`` sidecars sealed by an older manifest format
     for name in list(manifest):
-        if not os.path.isfile(os.path.join(path, name)):
+        if name.endswith("-atime") or not os.path.isfile(
+            os.path.join(path, name)
+        ):
             del manifest[name]
             changed = True
     if changed:
