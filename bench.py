@@ -637,7 +637,8 @@ def bench_priority(num_reads, seq_len, error_rate, iters=5, trace_out=None):
     return out
 
 
-def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None):
+def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None,
+                supervised=False):
     """Serving-throughput mode: N concurrent north-star-shaped single
     jobs through :class:`ConsensusService`, measuring jobs/s, mean batch
     occupancy of the cross-job dispatcher, and p50/p95 per-job latency.
@@ -645,27 +646,45 @@ def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None):
     One job is run serially first (warms the XLA compile cache so the
     timed window measures serving, not compilation) and its result
     doubles as the parity reference for the served job with the same
-    seed."""
+    seed.
+
+    ``supervised=True`` routes every served job's dispatches through the
+    fault-tolerant supervisor (the warmup stays unsupervised), which is
+    where ``WAFFLE_FAULTS`` injection applies — the CI flight-recorder
+    smoke uses this to make a served job demote deterministically."""
     from waffle_con_tpu import CdwfaConfigBuilder
     from waffle_con_tpu.serve import ConsensusService, JobRequest, ServeConfig
     from waffle_con_tpu.utils.example_gen import generate_test
 
     min_count = max(2, num_reads // 4)
     band = _band_seed(seq_len, error_rate)
-    cfg = (
+    builder = (
         CdwfaConfigBuilder()
         .min_count(min_count)
         .backend("jax")
         .initial_band(band)
-        .build()
     )
+    warm_cfg = builder.build()
+    if supervised:
+        builder = (
+            builder.supervised(True)
+            .dispatch_retries(1)
+            .retry_backoff_s(0.0)
+            .breaker_threshold(2)
+        )
+    cfg = builder.build()
     workloads = [
         generate_test(4, seq_len, num_reads, error_rate, seed=i)[1]
         for i in range(num_jobs)
     ]
 
+    # warmup runs unsupervised: it only exists to absorb XLA compiles,
+    # and keeping it outside the supervisor means WAFFLE_FAULTS
+    # injection (supervisor-scoped) fires inside the *served* jobs
     warm_start = time.perf_counter()
-    serial_reference = _make_engine("single", cfg, workloads[0]).consensus()
+    serial_reference = _make_engine(
+        "single", warm_cfg, workloads[0]
+    ).consensus()
     warm_time = time.perf_counter() - warm_start
 
     tracer = _obs_setup(trace_out)
@@ -717,6 +736,19 @@ def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None):
         "serve_stats": stats,
         "runtime_events": _runtime_events(),
     }
+    # rolling SLO snapshot (p50/p95/p99 + EWMA over dispatch latency and
+    # job wall time) and any flight-recorder incidents the run produced
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.obs import slo as obs_slo
+
+    out["slo"] = obs_slo.snapshot()
+    out["incidents"] = [
+        {k: i.get(k) for k in
+         ("seq", "reason", "trace_id", "unix_time", "path")}
+        for i in obs_flight.incidents()
+    ]
+    if supervised:
+        out["supervised"] = True
     slowest = (wall, tracer.chrome_events()) if tracer is not None else (wall, None)
     _obs_finish(out, tracer, trace_out, reports, slowest)
     return out
@@ -824,10 +856,22 @@ def _north_star_orchestrated(args) -> None:
     def want_device() -> bool:
         if args.platform == "cpu":
             return False
+        # a pinned-CPU environment can never yield a device backend; the
+        # probe subprocess would only burn its full timeout 3x (one per
+        # rung) before failing — observed as 3x90s in BENCH_r05.json
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            if device_state["ok"] is None:
+                probe_log.append("probe skipped (JAX_PLATFORMS=cpu pinned)")
+            device_state["ok"] = False
+            return False
         if args.platform == "device":
             return True
-        if device_state["ok"] is True:
-            return True
+        # cache the last probe outcome across rungs: a False answer is
+        # as sticky as a True one (re-probing every rung re-paid the
+        # probe timeout each time); only a device-side *attempt* failure
+        # resets the state to None to force an outage re-probe
+        if device_state["ok"] is not None:
+            return device_state["ok"]
         return probe_now()
 
     _BEST["backend_diag"] = diag
@@ -993,6 +1037,13 @@ def main() -> None:
         "p50/p95 job latency",
     )
     parser.add_argument(
+        "--serve-supervised", action="store_true",
+        help="with --serve: run the served jobs under the fault-"
+        "tolerant supervisor (warmup stays unsupervised), so "
+        "WAFFLE_FAULTS injection applies to the serving path — used by "
+        "the CI flight-recorder smoke",
+    )
+    parser.add_argument(
         "--platform", choices=("auto", "cpu", "device"), default="auto"
     )
     # hidden: one in-process bench attempt / gate run (orchestrator children)
@@ -1047,6 +1098,7 @@ def main() -> None:
             args.seq_len or (1000 if smoke else 2000),
             0.01,
             trace_out=args.trace_out,
+            supervised=args.serve_supervised,
         )
         out["device_platform"] = _current_platform()
         print(json.dumps(out))

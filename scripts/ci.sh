@@ -103,4 +103,53 @@ print(
 )
 PY
 
+echo "== flight-recorder smoke (fault-injected serve) =="
+FLIGHT_DIR="$(mktemp -d /tmp/waffle_ci_flight.XXXXXX)"
+FLIGHT_OUT="$(mktemp /tmp/waffle_ci_flight_out.XXXXXX.json)"
+trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT"' EXIT
+
+# two injected jax timeouts against breaker_threshold=2 force one served
+# job to demote mid-search; the always-on flight recorder must dump a
+# self-contained incident without any tracing/metrics pipeline enabled
+WAFFLE_FAULTS="timeout:jax:*:*:2" WAFFLE_FLIGHT_DIR="$FLIGHT_DIR" \
+  BENCH_SMOKE=1 \
+  python bench.py --serve 4 --serve-supervised --platform cpu \
+  > "$FLIGHT_OUT"
+
+python - "$FLIGHT_OUT" "$FLIGHT_DIR" <<'PY'
+import glob
+import json
+import sys
+
+out_path, flight_dir = sys.argv[1], sys.argv[2]
+
+with open(out_path) as fh:
+    evidence = json.loads(fh.read().strip().splitlines()[-1])
+assert evidence.get("supervised") is True, sorted(evidence)
+assert evidence["parity"] is True, "demoted job diverged from serial"
+slo = evidence.get("slo", {})
+for window in ("dispatch", "job"):
+    for q in ("p50_s", "p95_s", "p99_s"):
+        assert slo.get(window, {}).get(q) is not None, (window, q, slo)
+assert evidence.get("incidents"), "no incidents in serve evidence"
+
+dumps = sorted(glob.glob(f"{flight_dir}/incident-*.json"))
+assert dumps, f"no incident dump in {flight_dir}"
+with open(dumps[0]) as fh:
+    incident = json.load(fh)
+assert incident["schema"] == "waffle-flight-incident/1", incident["schema"]
+assert incident["reason"] == "backend_demoted", incident["reason"]
+assert incident["trace_id"], incident
+assert incident["detail"]["from_backend"] == "jax", incident["detail"]
+assert any(r["kind"] == "job_start" for r in incident["trace"]), (
+    [r["kind"] for r in incident["trace"]]
+)
+assert "job" in incident["slo"], sorted(incident["slo"])
+print(
+    f"ci flight smoke ok: {len(dumps)} incident dump(s), "
+    f"reason={incident['reason']}, trace={incident['trace_id']}, "
+    f"rolling job p95={slo['job']['p95_s']:.3f}s"
+)
+PY
+
 echo "== ci.sh: all green =="

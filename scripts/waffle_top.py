@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""waffle_top: live terminal view of a serving process.
+
+Polls the JSON stats file a :class:`ConsensusService` publishes when
+``WAFFLE_STATS_FILE`` is set (see ``serve/service.py``) and renders a
+compact top-style dashboard: job counts and queue depth, dispatcher
+batching occupancy, rolling SLO percentiles (p50/p95/p99 + EWMA over
+dispatch latency and job wall time), per-backend dispatch latency from
+the metrics snapshot, and the most recent flight-recorder incidents.
+
+Usage::
+
+    WAFFLE_STATS_FILE=/tmp/waffle_stats.json python bench.py --serve 8 &
+    python scripts/waffle_top.py /tmp/waffle_stats.json
+
+    python scripts/waffle_top.py /tmp/waffle_stats.json --once  # one frame
+
+No dependencies beyond the standard library; plain ANSI, no curses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+CLEAR = "\x1b[2J\x1b[H"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RESET = "\x1b[0m"
+
+
+def _fmt_s(value) -> str:
+    if value is None:
+        return "-"
+    if value < 0.001:
+        return f"{value * 1e6:.0f}us"
+    if value < 1.0:
+        return f"{value * 1e3:.1f}ms"
+    return f"{value:.2f}s"
+
+
+def _load(path: str):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _backend_latency_rows(metrics: dict) -> list:
+    """``(label, mean, count)`` per series of the dispatch-latency
+    histogram family."""
+    family = metrics.get("waffle_dispatch_latency_seconds", {})
+    rows = []
+    for label, hist in sorted(family.get("series", {}).items()):
+        count = hist.get("count", 0)
+        mean = hist.get("sum", 0.0) / count if count else None
+        rows.append((label, mean, count))
+    return rows
+
+
+def render(payload: dict, plain: bool = False) -> str:
+    bold = "" if plain else BOLD
+    dim = "" if plain else DIM
+    reset = "" if plain else RESET
+    lines = []
+    age = time.time() - payload.get("unix_time", 0)
+    lines.append(
+        f"{bold}waffle_top{reset} — service "
+        f"{payload.get('service', '?')!r}  "
+        f"{dim}(sampled {age:.1f}s ago){reset}"
+    )
+
+    stats = payload.get("stats", {})
+    jobs = stats.get("jobs", {})
+    lines.append(
+        f"jobs: submitted={jobs.get('submitted', 0)} "
+        f"done={jobs.get('done', 0)} failed={jobs.get('failed', 0)} "
+        f"expired={jobs.get('expired', 0)} "
+        f"cancelled={jobs.get('cancelled', 0)} "
+        f"rejected={jobs.get('rejected', 0)}  "
+        f"queue_depth={stats.get('queue_depth', 0)}"
+    )
+    dispatch = stats.get("dispatch", {})
+    lines.append(
+        f"dispatch: batches={dispatch.get('batches', 0)} "
+        f"coalesced={dispatch.get('coalesced_batches', 0)} "
+        f"direct={dispatch.get('direct_dispatches', 0)} "
+        f"mean_occupancy={dispatch.get('mean_batch_occupancy', 0):.2f} "
+        f"max_occupancy={dispatch.get('occupancy_max', 0)}"
+    )
+
+    slo = payload.get("slo", {})
+    lines.append(f"{bold}rolling SLO{reset} (k={slo.get('k')}, "
+                 f"slow_searches={slo.get('slow_searches', 0)})")
+    for window in ("dispatch", "job"):
+        w = slo.get(window, {})
+        lines.append(
+            f"  {window:>8}: n={w.get('count', 0):<5} "
+            f"p50={_fmt_s(w.get('p50_s'))} p95={_fmt_s(w.get('p95_s'))} "
+            f"p99={_fmt_s(w.get('p99_s'))} ewma={_fmt_s(w.get('ewma_s'))}"
+        )
+
+    metrics = payload.get("metrics")
+    if metrics:
+        rows = _backend_latency_rows(metrics)
+        if rows:
+            lines.append(f"{bold}dispatch latency by series{reset}")
+            for label, mean, count in rows[:8]:
+                lines.append(
+                    f"  {label[:52]:<52} mean={_fmt_s(mean)} n={count}"
+                )
+
+    incidents = payload.get("incidents", [])
+    lines.append(f"{bold}recent incidents{reset} ({len(incidents)})")
+    for inc in incidents[-5:]:
+        when = time.strftime(
+            "%H:%M:%S", time.localtime(inc.get("unix_time", 0))
+        )
+        lines.append(
+            f"  [{when}] {inc.get('reason')} "
+            f"trace={inc.get('trace_id') or '-'} "
+            f"{dim}{inc.get('path') or '(in-memory)'}{reset}"
+        )
+    if not incidents:
+        lines.append(f"  {dim}none{reset}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "stats_file", nargs="?",
+        default=os.environ.get("WAFFLE_STATS_FILE", ""),
+        help="stats JSON written by the service (WAFFLE_STATS_FILE)",
+    )
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render one frame (no screen clear) and exit",
+    )
+    parser.add_argument(
+        "--plain", action="store_true", help="no ANSI styling"
+    )
+    args = parser.parse_args()
+    if not args.stats_file:
+        parser.error("no stats file (argument or WAFFLE_STATS_FILE)")
+
+    while True:
+        payload = _load(args.stats_file)
+        if payload is None:
+            frame = (
+                f"waffle_top: waiting for {args.stats_file} "
+                "(is a service running with WAFFLE_STATS_FILE set?)"
+            )
+        else:
+            frame = render(payload, plain=args.plain or args.once)
+        if args.once:
+            print(frame)
+            return 0 if payload is not None else 1
+        sys.stdout.write(CLEAR + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -326,3 +326,190 @@ def test_search_report_without_obs_enabled():
     rep = engine.last_search_report
     assert rep.nodes_explored > 0
     assert rep.time_breakdown == {}  # no tracer -> no breakdown
+
+
+# ----------------------------------------------- bucket-index semantics
+
+
+def _naive_bucket_index(bounds, value):
+    """The linear scan `_bucket_index` replaced — the semantic oracle."""
+    for i, b in enumerate(bounds):
+        if value <= b:
+            return i
+    return len(bounds)
+
+
+def test_bucket_index_matches_naive_scan_property():
+    """Property-style sweep: the bisect-based index agrees with the
+    naive scan everywhere, including values exactly ON an upper bound
+    (inclusive), just below/above it, and the NaN/inf edges."""
+    rng = np.random.default_rng(7)
+    bound_sets = [
+        (0.001,),
+        (0.001, 0.01, 0.1),
+        obs_metrics.DEFAULT_LATENCY_BUCKETS,
+        obs_metrics.DEFAULT_COUNT_BUCKETS,
+        tuple(sorted(rng.uniform(-10, 10, size=13))),
+    ]
+    for bounds in bound_sets:
+        h = Histogram(bounds=bounds)
+        probes = list(h.bounds)                            # exactly on
+        probes += [b - 1e-12 for b in h.bounds]            # just below
+        probes += [b + 1e-12 for b in h.bounds]            # just above
+        probes += list(rng.uniform(-20, 20, size=200))
+        probes += [0.0, -1e30, 1e30, float("inf"), float("-inf"),
+                   float("nan")]
+        for v in probes:
+            assert h._bucket_index(v) == _naive_bucket_index(h.bounds, v), (
+                bounds, v,
+            )
+
+
+def test_bucket_index_exact_upper_bound_is_inclusive():
+    h = Histogram(bounds=(1.0, 2.0, 4.0))
+    h.observe(2.0)  # exactly on a bound -> that bucket, not the next
+    assert h.counts == [0, 1, 0, 0]
+
+
+# --------------------------------------- event-log drop counter satellite
+
+
+def test_event_log_drops_surface_as_registry_counter(obs_on, monkeypatch):
+    monkeypatch.setattr(events, "_MAX_EVENTS", 4)
+    events.clear_events()
+    try:
+        for i in range(9):
+            events.record("drop_probe", i=i)
+        log = events.get_events()
+        assert log[-1]["kind"] == "event_log_saturated"
+        dropped_in_marker = log[-1]["dropped"]
+        snap = obs_metrics.registry().snapshot()
+        counter = snap["waffle_runtime_events_dropped_total"]["series"]["{}"]
+        assert counter == dropped_in_marker == 5
+    finally:
+        events.clear_events()
+
+
+# ----------------------------------------------------- rolling SLO windows
+
+
+@pytest.fixture
+def slo_clean():
+    from waffle_con_tpu.obs import flight, slo
+
+    flight.reset()
+    slo.reset()
+    try:
+        yield slo
+    finally:
+        flight.reset()
+        slo.reset()
+
+
+def test_rolling_window_percentiles_and_ewma(slo_clean):
+    from waffle_con_tpu.obs.slo import RollingWindow
+
+    w = RollingWindow(max_age_s=300.0, max_count=1000)
+    for v in range(1, 101):  # 1..100 ms
+        w.observe(v / 1000.0)
+    p = w.percentiles()
+    assert p["p50"] == pytest.approx(0.050)
+    assert p["p95"] == pytest.approx(0.095)
+    assert p["p99"] == pytest.approx(0.099)
+    assert 0.0 < w.ewma < 0.1
+    assert len(w) == 100
+
+
+def test_rolling_window_expires_old_samples(slo_clean):
+    from waffle_con_tpu.obs.slo import RollingWindow
+
+    w = RollingWindow(max_age_s=10.0, max_count=1000)
+    w.observe(5.0, now=100.0)      # will age out
+    w.observe(0.001, now=109.0)
+    assert w.percentiles(now=111.0)["p99"] == pytest.approx(0.001)
+    assert len(w) == 1
+
+
+def test_slow_search_checked_against_prior_baseline(slo_clean):
+    slo = slo_clean
+    for _ in range(30):
+        assert slo.observe_search(0.01) is False
+    # 1s >> 3 x p95(10ms): flagged, and judged BEFORE joining the window
+    assert slo.observe_search(1.0) is True
+    # the outlier joined the window afterwards; an identical repeat is
+    # now judged against the diluted window but p95 is still ~10ms
+    snap = slo.snapshot()
+    assert snap["slow_searches"] == 1
+    assert snap["job"]["count"] == 31
+
+
+def test_slo_collector_publishes_into_exposition(obs_on, slo_clean):
+    slo = slo_clean
+    for v in (0.01, 0.02, 0.03):
+        slo.observe_dispatch(v)
+    slo.observe_job(0.5)
+    text = obs_metrics.registry().render_prometheus()
+    assert "waffle_slo_dispatch_latency_seconds" in text
+    assert "waffle_slo_job_latency_seconds" in text
+    assert 'quantile="p95"' in text and 'quantile="ewma"' in text
+    snap = obs_metrics.registry().snapshot()
+    assert "waffle_slo_window_samples" in snap
+
+
+def test_cold_tracker_leaves_registry_untouched(slo_clean):
+    reg = MetricsRegistry()
+    from waffle_con_tpu.obs.slo import SloTracker
+
+    SloTracker().publish(reg)
+    assert reg.snapshot() == {}
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_ring_is_bounded_and_filterable(slo_clean):
+    from waffle_con_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(ring_size=16)
+    for i in range(40):
+        rec.record("probe", trace_id=f"t{i % 2}", i=i)
+    records = rec.records()
+    assert len(records) == 16  # bounded
+    assert records[-1]["i"] == 39
+    only_t0 = rec.records(trace_id="t0")
+    assert only_t0 and all(r["trace_id"] == "t0" for r in only_t0)
+
+
+def test_flight_trigger_dedupes_and_stays_in_memory(slo_clean, tmp_path,
+                                                    monkeypatch):
+    from waffle_con_tpu.obs import flight
+
+    monkeypatch.delenv("WAFFLE_FLIGHT_DIR", raising=False)
+    flight.record("step", trace_id="job-1", n=1)
+    first = flight.trigger("deadline_exceeded", trace_id="job-1",
+                           overrun_s=0.2)
+    assert first is not None
+    assert first["trace"] and first["trace"][0]["kind"] == "step"
+    assert "path" not in first  # no dir -> memory only, no file
+    # same (reason, trace) dedupes; a different trace id still fires
+    assert flight.trigger("deadline_exceeded", trace_id="job-1") is None
+    assert flight.trigger("deadline_exceeded", trace_id="job-2") is not None
+    assert len(flight.incidents()) == 2
+
+
+def test_flight_dump_writes_parseable_incident(slo_clean, tmp_path,
+                                               monkeypatch):
+    from waffle_con_tpu.obs import flight
+
+    monkeypatch.setenv("WAFFLE_FLIGHT_DIR", str(tmp_path))
+    flight.record("step", trace_id="job-9", n=1)
+    incident = flight.trigger("watchdog_budget_exceeded",
+                              trace_id="job-9", total=10, budget=5)
+    files = list(tmp_path.glob("incident-*-watchdog_budget_exceeded.json"))
+    assert len(files) == 1
+    on_disk = json.loads(files[0].read_text())
+    assert on_disk["schema"] == "waffle-flight-incident/1"
+    assert on_disk["reason"] == "watchdog_budget_exceeded"
+    assert on_disk["detail"] == {"total": 10, "budget": 5}
+    assert on_disk["trace_id"] == "job-9"
+    assert incident["path"] == str(files[0])

@@ -1,0 +1,353 @@
+"""Per-job distributed tracing + flight recorder contract tests.
+
+Core claims under test:
+
+* In a multi-tenant serve run, every job's Chrome trace export is a
+  **single connected span tree** under the job's own trace id and
+  Chrome pid — worker-thread search spans and dispatcher-thread
+  dispatch spans linked by parent ids, stitched across the thread hop
+  by flow events.
+* The span structure is **byte-identical** whether a job's dispatches
+  were coalesced by the batching dispatcher or fell through the
+  single-tenant direct path.
+* A fault-injected job — with tracing *disabled* — still yields exactly
+  one self-contained flight-recorder incident dump carrying that job's
+  ring records, the runtime event log, and the SLO snapshot.
+"""
+
+import json
+
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder
+from waffle_con_tpu.obs import flight, slo
+from waffle_con_tpu.obs import trace as obs_trace
+from waffle_con_tpu.serve import (
+    ConsensusService,
+    JobRequest,
+    ServeConfig,
+)
+from waffle_con_tpu.utils.example_gen import generate_test
+from waffle_con_tpu.utils.fixtures import (
+    load_dual_fixture,
+    load_priority_fixture,
+)
+
+pytestmark = pytest.mark.serve
+
+DUAL_READS = (b"ACGTACGT", b"ACGTACGT", b"ACTTACGT", b"ACTTACGT")
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().backend("python")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _mixed_requests():
+    """Eight mixed-engine jobs (same shape as tests/test_serve.py)."""
+    fcfg = _cfg(wildcard=ord("*"))
+    requests = []
+    sequences, _ = load_dual_fixture("dual_001", True, fcfg.consensus_cost)
+    requests.append(
+        JobRequest(kind="dual", reads=tuple(sequences), config=fcfg)
+    )
+    for name, include in (
+        ("multi_exact_001", True),
+        ("multi_err_001", False),
+        ("multi_samesplit_001", True),
+        ("priority_001", True),
+    ):
+        chains, _ = load_priority_fixture(name, include, fcfg.consensus_cost)
+        requests.append(
+            JobRequest(
+                kind="priority",
+                reads=tuple(tuple(c) for c in chains),
+                config=fcfg,
+                tag=name,
+            )
+        )
+    scfg = _cfg(min_count=2)
+    for seed in (0, 1):
+        _, reads = generate_test(4, 160, 6, 0.02, seed=seed)
+        requests.append(
+            JobRequest(kind="single", reads=tuple(reads), config=scfg)
+        )
+    requests.append(
+        JobRequest(kind="dual", reads=DUAL_READS, config=_cfg(min_count=1))
+    )
+    return requests
+
+
+@pytest.fixture
+def traced():
+    """Tracing on with clean tracer/flight/SLO state, restored after."""
+    tracer = obs_trace.get_tracer()
+    tracer.enable(True)
+    tracer.clear()
+    flight.reset()
+    slo.reset()
+    try:
+        yield tracer
+    finally:
+        tracer.reset_enabled()
+        tracer.clear()
+        flight.reset()
+        slo.reset()
+
+
+@pytest.fixture
+def obs_clean():
+    """Clean flight/SLO state with tracing left disabled."""
+    flight.reset()
+    slo.reset()
+    try:
+        yield
+    finally:
+        flight.reset()
+        slo.reset()
+
+
+# ------------------------------------------------ span-tree helpers
+
+
+def _job_spans(events, trace_id):
+    """The complete ``ph == "X"`` spans belonging to one trace."""
+    return [
+        e for e in events
+        if e.get("ph") == "X"
+        and e.get("args", {}).get("trace_id") == trace_id
+    ]
+
+
+def _span_tree(spans):
+    """Normalized structure of a span set: a sorted list of root
+    ``[name, children]`` shapes built from the parent links (flow
+    events, timestamps, and thread ids are deliberately excluded —
+    structure, not timing, must be identical across dispatch paths)."""
+    children = {}
+    roots = []
+    for e in spans:
+        parent = e["args"]["parent_id"]
+        if parent is None:
+            roots.append(e)
+        else:
+            children.setdefault(parent, []).append(e)
+
+    def shape(e):
+        kids = sorted(
+            shape(c) for c in children.get(e["args"]["span_id"], [])
+        )
+        return [e["name"], kids]
+
+    return sorted(shape(r) for r in roots)
+
+
+# ------------------------------------------------ multi-tenant tracing
+
+
+def test_every_job_gets_one_connected_span_tree(traced):
+    requests = _mixed_requests()
+    assert len(requests) == 8
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=0.02)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        for h in handles:
+            h.result(timeout=300)
+        stats = svc.stats()
+    assert stats["jobs"]["done"] == len(requests)
+
+    events = traced.chrome_events()
+    for h in handles:
+        trace_id = h.trace.trace_id
+        spans = _job_spans(events, trace_id)
+        assert spans, f"no spans recorded for {trace_id}"
+        # every span carries the job's Chrome pid (its own process row)
+        assert {e["pid"] for e in spans} == {h.trace.chrome_pid}, trace_id
+        # parent linkage is closed: every non-root parent id exists
+        ids = {e["args"]["span_id"] for e in spans}
+        for e in spans:
+            parent = e["args"]["parent_id"]
+            assert parent is None or parent in ids, (trace_id, e)
+        # one single connected tree, rooted at the job's serve:job span
+        tree = _span_tree(spans)
+        assert len(tree) == 1, (trace_id, [t[0] for t in tree])
+        assert tree[0][0] == "serve:job"
+        # the tree spans both threads' work: a search span under the
+        # root and at least one dispatch span under the search
+        names = {e["name"] for e in spans}
+        assert "search" in names, trace_id
+        assert any(n.startswith("dispatch:") for n in names), trace_id
+
+    # jobs render as their own named Perfetto process rows
+    meta_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert {h.trace.chrome_pid for h in handles} <= meta_pids
+
+
+def test_flow_events_stitch_worker_to_dispatcher(traced):
+    """Coalesced dispatches emit paired flow start/finish events with
+    matching ids, on two distinct threads of the job's pid."""
+    _, reads = generate_test(4, 160, 6, 0.02, seed=0)
+    requests = [
+        JobRequest(
+            kind="single", reads=tuple(reads), config=_cfg(min_count=2)
+        )
+        for _ in range(4)
+    ]
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=0.02)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        for h in handles:
+            h.result(timeout=300)
+        stats = svc.stats()
+    assert stats["dispatch"]["routed_requests"] >= 1
+
+    events = traced.chrome_events()
+    starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+    assert starts, "no flow-start events recorded"
+    paired = set(starts) & set(finishes)
+    assert paired, "no flow start/finish pair with a matching id"
+    job_pids = {h.trace.chrome_pid for h in handles}
+    for flow_id in paired:
+        s, f = starts[flow_id], finishes[flow_id]
+        assert s["pid"] in job_pids and f["pid"] in job_pids
+        assert s["tid"] != f["tid"], "flow did not cross threads"
+        assert s["ts"] <= f["ts"]
+
+
+def test_span_tree_byte_identical_coalesced_vs_direct(traced):
+    _, reads = generate_test(4, 160, 6, 0.02, seed=3)
+
+    def request():
+        return JobRequest(
+            kind="single", reads=tuple(reads), config=_cfg(min_count=2)
+        )
+
+    # direct fall-through: the job is alone, no batching window latency
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        solo = svc.submit(request())
+        solo.result(timeout=300)
+        solo_stats = svc.stats()
+    assert solo_stats["dispatch"]["routed_requests"] == 0
+    direct_tree = _span_tree(
+        _job_spans(traced.chrome_events(), solo.trace.trace_id)
+    )
+    assert direct_tree, "no direct-path span tree"
+
+    traced.clear()
+
+    # coalesced: four copies of the same job race through the window
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=0.02)
+    ) as svc:
+        handles = svc.submit_all([request() for _ in range(4)])
+        for h in handles:
+            h.result(timeout=300)
+        stats = svc.stats()
+    assert stats["dispatch"]["routed_requests"] >= 1, (
+        "nothing was routed through the dispatcher"
+    )
+
+    events = traced.chrome_events()
+    direct_bytes = json.dumps(direct_tree, sort_keys=True).encode()
+    for h in handles:
+        tree = _span_tree(_job_spans(events, h.trace.trace_id))
+        got = json.dumps(tree, sort_keys=True).encode()
+        assert got == direct_bytes, (
+            f"{h.trace.trace_id} span structure diverged from the "
+            "single-tenant direct path"
+        )
+
+
+# ------------------------------------------------ flight recorder
+
+
+def test_fault_injected_job_yields_exactly_one_incident_dump(
+    faults, tmp_path, monkeypatch, obs_clean
+):
+    """Tracing stays DISABLED: the always-on flight recorder alone must
+    reconstruct the demoted job's timeline in a single incident file."""
+    assert not obs_trace.tracing_enabled()
+    monkeypatch.setenv("WAFFLE_FLIGHT_DIR", str(tmp_path))
+
+    def cfg(**kw):
+        b = CdwfaConfigBuilder().min_count(1).backend("jax")
+        for k, v in kw.items():
+            b = getattr(b, k)(v)
+        return b.build()
+
+    faults.add("timeout", backend="jax", at=3, count=None)
+    faults.add("timeout", backend="jax", at=4, count=None)
+    sup = cfg(
+        backend_chain=("python",), dispatch_retries=1,
+        breaker_threshold=2, retry_backoff_s=0.0,
+    )
+    reads = (b"ACGTACGTACGT", b"ACGTACGTACGT", b"ACCTACGTACGT")
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        h = svc.submit(JobRequest(kind="single", reads=reads, config=sup))
+        h.result(timeout=300)
+
+    dumps = sorted(tmp_path.glob("incident-*.json"))
+    assert len(dumps) == 1, [p.name for p in dumps]
+    incident = json.loads(dumps[0].read_text())
+    assert incident["schema"] == "waffle-flight-incident/1"
+    assert incident["reason"] == "backend_demoted"
+    assert incident["trace_id"] == h.trace.trace_id
+    assert incident["detail"]["from_backend"] == "jax"
+    assert incident["detail"]["to_backend"] == "python"
+    # the dump is self-contained: the job's own ring records rode along
+    kinds = [r["kind"] for r in incident["trace"]]
+    assert "job_start" in kinds, kinds
+    assert all(r["trace_id"] == h.trace.trace_id for r in incident["trace"])
+    # recent runtime events and the SLO snapshot are embedded
+    assert any(
+        e["kind"] == "backend_demoted" for e in incident["events"]
+    )
+    assert "slo" in incident and "job" in incident["slo"]
+    # the in-memory incident list mirrors the file (and records its path)
+    mem = flight.incidents()
+    assert len(mem) == 1 and mem[0]["path"] == str(dumps[0])
+
+
+def test_no_anomaly_means_no_dump(tmp_path, monkeypatch, obs_clean):
+    monkeypatch.setenv("WAFFLE_FLIGHT_DIR", str(tmp_path))
+    _, reads = generate_test(4, 160, 6, 0.02, seed=1)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        h = svc.submit(
+            JobRequest(
+                kind="single", reads=tuple(reads), config=_cfg(min_count=2)
+            )
+        )
+        h.result(timeout=300)
+    assert list(tmp_path.glob("*.json")) == []
+    assert flight.incidents() == []
+
+
+def test_deadline_exceeded_triggers_incident_without_tracing(
+    tmp_path, monkeypatch, obs_clean
+):
+    monkeypatch.setenv("WAFFLE_FLIGHT_DIR", str(tmp_path))
+    _, reads = generate_test(4, 400, 8, 0.02, seed=2)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        h = svc.submit(
+            JobRequest(
+                kind="single", reads=tuple(reads),
+                config=_cfg(min_count=2), deadline_s=1e-6,
+            )
+        )
+        h.wait(timeout=300)
+    assert h.status.value == "expired"
+    dumps = sorted(tmp_path.glob("incident-*-deadline_exceeded.json"))
+    assert len(dumps) == 1, [p.name for p in dumps]
+    incident = json.loads(dumps[0].read_text())
+    assert incident["trace_id"] == h.trace.trace_id
+    assert any(
+        r["kind"] == "job_start" for r in incident["trace"]
+    ), incident["trace"]

@@ -17,9 +17,30 @@ disabled:
   structured per-search summary every engine stores as
   ``last_search_report`` and ``bench.py`` embeds in evidence JSON.
 
+Two **always-on** pieces ride alongside (both lock-cheap by design;
+the hot-loop 620 steps/s floor gates their overhead):
+
+* :mod:`~waffle_con_tpu.obs.flight` — bounded flight-recorder ring of
+  recent serve/search records that dumps a self-contained JSON incident
+  (``WAFFLE_FLIGHT_DIR``) when an anomaly trigger fires (deadline
+  exceeded, backend demotion, cache quarantine, service overload,
+  watchdog budget breach, slow search) — post-hoc debuggability without
+  pre-enabled tracing.
+* :mod:`~waffle_con_tpu.obs.slo` — rolling p50/p95/p99 + EWMA windows
+  over dispatch and job/search latency, re-published into the metrics
+  exposition via a registry collector, and the source of the
+  ``slow_search`` trigger (current search > k x rolling p95).
+
+Per-job tracing: the serve layer gives every job a
+:class:`~waffle_con_tpu.obs.trace.TraceContext` (own Chrome pid, span
+parent linkage across the worker->dispatcher thread hop, flow-event
+stitching), so a multi-tenant trace export shows one connected span
+tree per job.
+
 The runtime event log (:mod:`waffle_con_tpu.runtime.events`) is one
 sink of this pipeline: every recorded event also bumps the
-``waffle_runtime_events_total`` counter when metrics are on.
+``waffle_runtime_events_total`` counter when metrics are on (and
+``waffle_runtime_events_dropped_total`` when the log saturates).
 """
 
 from waffle_con_tpu.obs.metrics import (  # noqa: F401
@@ -34,11 +55,22 @@ from waffle_con_tpu.obs.metrics import (  # noqa: F401
     registry,
     reset_metrics_enabled,
 )
+from waffle_con_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    TRIGGER_REASONS,
+    get_recorder,
+)
 from waffle_con_tpu.obs.report import SearchReport  # noqa: F401
+from waffle_con_tpu.obs.slo import SloTracker  # noqa: F401
 from waffle_con_tpu.obs.trace import (  # noqa: F401
+    JOB_PID_BASE,
     NULL_SPAN,
+    TraceContext,
     Tracer,
+    current_context,
+    current_trace_id,
     get_tracer,
+    set_current_context,
     span,
     tracing_enabled,
 )

@@ -1,0 +1,211 @@
+"""Always-on flight recorder: bounded in-memory ring + incident dumps.
+
+Post-hoc debuggability for the serving stack: when one of many
+concurrent jobs blows its deadline, demotes a backend, or trips the
+admission queue, the operator needs that job's recent timeline *without
+having pre-enabled tracing*.  The recorder therefore runs always-on and
+lock-cheap — a fixed-size ``collections.deque`` ring of pre-rendered
+tuples (``deque.append`` with ``maxlen`` is atomic under the GIL, so
+the hot recording path takes no lock and allocates one small tuple per
+record) — and only does real work when an **anomaly trigger** fires.
+
+Triggers (see :data:`TRIGGER_REASONS`): ``deadline_exceeded``,
+``backend_demoted``, ``cache_quarantine``, ``service_overloaded``,
+``watchdog_budget_exceeded``, and the SLO layer's ``slow_search``
+(current search > k× rolling p95, :mod:`waffle_con_tpu.obs.slo`).
+
+On a trigger the recorder assembles a self-contained JSON **incident**:
+the triggering job's records (filtered from the ring by trace id),
+the recent ring tail, the runtime event log, a metrics snapshot (when
+metrics are on), and the rolling SLO snapshot.  With
+``WAFFLE_FLIGHT_DIR`` set the incident is also written to
+``<dir>/incident-<seq>-<reason>.json`` (atomic rename); unset, incidents
+stay in memory only (:meth:`FlightRecorder.incidents`) so test and
+library runs never litter the working directory.
+
+Incidents are deduplicated on ``(reason, trace_id)`` — a retry storm
+produces one dump, not hundreds.  ``WAFFLE_FLIGHT_RING`` sizes the ring
+(default 2048 records).
+
+Overhead contract: the microbench/raw-engine path makes **zero** calls
+into this module (recording happens at serve-layer dispatch and job
+boundaries plus anomaly sites), so the 620 steps/s hot-loop floor is
+unaffected by construction; in the serving path a record is one deque
+append.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+#: every reason :func:`trigger` is called with somewhere in the codebase
+TRIGGER_REASONS = (
+    "deadline_exceeded",
+    "backend_demoted",
+    "cache_quarantine",
+    "service_overloaded",
+    "watchdog_budget_exceeded",
+    "slow_search",
+)
+
+DEFAULT_RING_SIZE = 2048
+#: in-memory incident cap (dumped files are bounded by dedupe instead)
+MAX_INCIDENTS = 64
+INCIDENT_SCHEMA = "waffle-flight-incident/1"
+
+
+def _ring_size() -> int:
+    try:
+        return max(16, int(os.environ.get("WAFFLE_FLIGHT_RING", "") or
+                           DEFAULT_RING_SIZE))
+    except ValueError:
+        return DEFAULT_RING_SIZE
+
+
+def _jsonable(value):
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    return repr(value)
+
+
+class FlightRecorder:
+    """Bounded ring of recent records plus incident assembly/dump."""
+
+    def __init__(self, ring_size: Optional[int] = None) -> None:
+        self._ring: "collections.deque[Tuple]" = collections.deque(
+            maxlen=ring_size or _ring_size()
+        )
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._seq = 0
+        self._incidents: List[Dict] = []
+
+    # -- hot path ------------------------------------------------------
+
+    def record(self, kind: str, /, trace_id: Optional[str] = None,
+               **fields) -> None:
+        """Append one pre-rendered record to the ring (no lock: deque
+        append with ``maxlen`` is atomic).  ``kind`` is positional-only
+        so callers may carry a ``kind=...`` field of their own."""
+        self._ring.append(
+            (time.time(), kind, trace_id, tuple(fields.items()))
+        )
+
+    # -- reads ---------------------------------------------------------
+
+    def records(self, trace_id: Optional[str] = None,
+                limit: Optional[int] = None) -> List[Dict]:
+        """Point-in-time copy of the ring as dicts, oldest first,
+        optionally filtered to one trace and/or tail-limited."""
+        snap = list(self._ring)
+        if trace_id is not None:
+            snap = [r for r in snap if r[2] == trace_id]
+        if limit is not None:
+            snap = snap[-limit:]
+        return [
+            {**dict(fields), "ts": ts, "kind": kind, "trace_id": tid}
+            for ts, kind, tid, fields in snap
+        ]
+
+    def incidents(self) -> List[Dict]:
+        with self._lock:
+            return [dict(i) for i in self._incidents]
+
+    # -- anomaly path --------------------------------------------------
+
+    def trigger(self, reason: str, trace_id: Optional[str] = None,
+                **detail) -> Optional[Dict]:
+        """Fire an anomaly trigger: assemble an incident (and dump it to
+        ``WAFFLE_FLIGHT_DIR`` when set).  Returns the incident dict, or
+        ``None`` when ``(reason, trace_id)`` already fired (dedupe)."""
+        key = (reason, trace_id)
+        with self._lock:
+            if key in self._seen:
+                return None
+            self._seen.add(key)
+            self._seq += 1
+            seq = self._seq
+        incident = self._build_incident(seq, reason, trace_id, detail)
+        dump_dir = os.environ.get("WAFFLE_FLIGHT_DIR", "")
+        if dump_dir:
+            try:
+                os.makedirs(dump_dir, exist_ok=True)
+                path = os.path.join(
+                    dump_dir, f"incident-{seq:04d}-{reason}.json"
+                )
+                tmp = f"{path}.tmp-{os.getpid()}"
+                with open(tmp, "w") as fh:
+                    json.dump(incident, fh, indent=1, default=repr)
+                os.replace(tmp, path)
+                incident["path"] = path
+            except OSError:
+                # a full/readonly dump dir must never take down serving;
+                # the incident still lands in memory below
+                incident["path"] = None
+        with self._lock:
+            self._incidents.append(incident)
+            del self._incidents[:-MAX_INCIDENTS]
+        return incident
+
+    def _build_incident(self, seq: int, reason: str,
+                        trace_id: Optional[str], detail: Dict) -> Dict:
+        from waffle_con_tpu.obs import metrics as obs_metrics
+        from waffle_con_tpu.obs import slo as obs_slo
+        from waffle_con_tpu.runtime import events as runtime_events
+
+        incident: Dict = {
+            "schema": INCIDENT_SCHEMA,
+            "seq": seq,
+            "reason": reason,
+            "trace_id": trace_id,
+            "unix_time": time.time(),
+            "detail": {str(k): _jsonable(v) for k, v in detail.items()},
+            "trace": self.records(trace_id=trace_id) if trace_id else [],
+            "recent": self.records(limit=256),
+            "events": runtime_events.get_events()[-256:],
+            "slo": obs_slo.snapshot(),
+        }
+        if obs_metrics.metrics_enabled():
+            incident["metrics"] = obs_metrics.registry().snapshot()
+        return incident
+
+    def reset(self) -> None:
+        """Drop ring, dedupe state, and in-memory incidents (tests)."""
+        with self._lock:
+            self._ring.clear()
+            self._seen.clear()
+            self._incidents.clear()
+            self._seq = 0
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, /, trace_id: Optional[str] = None, **fields) -> None:
+    _RECORDER.record(kind, trace_id=trace_id, **fields)
+
+
+def trigger(reason: str, trace_id: Optional[str] = None,
+            **detail) -> Optional[Dict]:
+    return _RECORDER.trigger(reason, trace_id=trace_id, **detail)
+
+
+def incidents() -> List[Dict]:
+    return _RECORDER.incidents()
+
+
+def reset() -> None:
+    _RECORDER.reset()

@@ -22,9 +22,10 @@ created and the engines' per-search cost is a handful of boolean checks.
 
 from __future__ import annotations
 
+import bisect
 import os
 import threading
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 #: default latency buckets (seconds): spans the observed dispatch range
 #: from sub-100us fused XLA:CPU calls to multi-second tunneled TPU
@@ -103,12 +104,13 @@ class Histogram:
         self._lock = threading.Lock()
 
     def _bucket_index(self, value: float) -> int:
-        # linear scan: bucket lists are short (<=20) and the scan is
-        # branch-predictable; bisect costs more in call overhead
-        for i, b in enumerate(self.bounds):
-            if value <= b:
-                return i
-        return len(self.bounds)
+        # bisect_left matches the inclusive-upper-edge contract
+        # (value == bounds[i] lands in bucket i); NaN compares False
+        # against everything, which bisect would place at index 0 —
+        # route it to the +Inf overflow bucket like the scan it replaced
+        if value != value:
+            return len(self.bounds)
+        return bisect.bisect_left(self.bounds, value)
 
     def observe(self, value: float) -> None:
         i = self._bucket_index(value)
@@ -163,6 +165,25 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         #: name -> (kind, {label_key: instrument}, histogram bounds)
         self._families: Dict[str, Tuple[str, Dict[_LabelKey, object], Optional[tuple]]] = {}
+        #: exposition-time callbacks (e.g. the SLO tracker re-publishing
+        #: rolling percentiles as gauges); run before every snapshot
+        self._collectors: List[Callable[[], None]] = []
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callback invoked at the start of every
+        :meth:`snapshot` / :meth:`render_prometheus` so derived metrics
+        (rolling percentiles) are fresh at read time."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # a broken collector must not kill reads
+                pass
 
     def _child(self, kind: str, name: str, labels: Dict[str, str],
                bounds: Optional[Iterable[float]] = None):
@@ -207,6 +228,7 @@ class MetricsRegistry:
     def snapshot(self) -> Dict:
         """JSON-ready dump: ``{name: {"type": ..., "series": {labelstr:
         value-or-histogram-dict}}}`` (the form ``bench.py`` embeds)."""
+        self._collect()
         with self._lock:
             families = {
                 name: (kind, dict(children))
@@ -235,6 +257,7 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4."""
+        self._collect()
         with self._lock:
             families = {
                 name: (kind, dict(children))

@@ -112,7 +112,9 @@ def run_reported_search(engine, engine_label: str, impl: Callable):
     at INFO when ``config.log_search_summary`` is set, else at DEBUG.
     """
     # lazy submodule imports keep obs.report importable mid-package-init
+    from waffle_con_tpu.obs import flight as obs_flight
     from waffle_con_tpu.obs import metrics as obs_metrics
+    from waffle_con_tpu.obs import slo as obs_slo
     from waffle_con_tpu.obs import trace as obs_trace
 
     tracer = obs_trace.get_tracer()
@@ -145,6 +147,20 @@ def run_reported_search(engine, engine_label: str, impl: Callable):
         time_breakdown=breakdown,
         n_results=n_results,
         consensus_len=consensus_len,
+    )
+    trace_id = obs_trace.current_trace_id()
+    if trace_id is not None:
+        report.extra["trace_id"] = trace_id
+    # rolling-SLO check BEFORE this sample joins the window (a
+    # pathological search must not dilute the baseline it is judged
+    # against); fires the flight recorder's slow_search trigger
+    if obs_slo.observe_search(wall_s, trace_id=trace_id):
+        report.extra["slow_search"] = True
+    obs_flight.record(
+        "search", trace_id=trace_id, engine=engine_label,
+        backend=report.backend, wall_s=round(wall_s, 6),
+        dispatches=report.dispatch_total,
+        nodes=report.nodes_explored,
     )
     engine.last_search_report = report
 

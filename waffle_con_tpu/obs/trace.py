@@ -19,6 +19,26 @@ Overhead contract: with tracing off (``WAFFLE_TRACE`` unset and no
 programmatic enable), :func:`span` returns a shared no-op context
 manager singleton — no allocation, no timestamps, no lock.
 
+Trace contexts (multi-tenant serving): a :class:`TraceContext` gives a
+served job its own trace identity — a stable ``trace_id`` string, a
+dedicated Chrome ``pid`` (so Perfetto groups each job's spans under its
+own process row), and a per-context stack of open span ids that carries
+parent linkage *across threads*.  The serve worker activates its job's
+context for the duration of the job (:func:`set_current_context`), and
+the batching dispatcher re-activates the submitting job's context
+around each coalesced dispatch execution, so a span opened on the
+dispatcher thread still records the job's ``pid`` and parents under the
+worker-side span that submitted it.  The cross-thread hop itself is
+stitched with Chrome flow events (:meth:`Tracer.flow`).
+
+Context safety contract: a context's span stack is only ever touched by
+the one thread currently *running* the job — the worker parks while the
+dispatcher executes its dispatch — so the stack needs no lock.  Context
+activation is a plain thread-local assignment and is always on (the
+flight recorder reads :func:`current_trace_id` even when tracing is
+disabled); spans themselves still cost nothing unless tracing is
+enabled.
+
 ``WAFFLE_TRACE`` values: ``1`` enables recording; any other non-empty,
 non-``0`` value is treated as an output path written at interpreter
 exit.  ``WAFFLE_TRACE_JAX=1`` additionally turns on the jax.profiler
@@ -50,10 +70,84 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 
-class _Span:
-    """A live span; appends one Chrome complete event on exit."""
+class TraceContext:
+    """Per-job trace identity and cross-thread parent linkage.
 
-    __slots__ = ("_tracer", "name", "cat", "args", "_start_ns", "_jax_ctx")
+    ``trace_id`` names the trace (e.g. ``"consensus/job-3"``),
+    ``chrome_pid`` is the Chrome trace ``pid`` the job's spans render
+    under, and the span-id stack carries parent linkage for spans opened
+    on whichever thread currently runs the job (see module docstring for
+    the single-runner safety contract).
+    """
+
+    __slots__ = ("trace_id", "chrome_pid", "label", "_stack", "_next_id")
+
+    def __init__(self, trace_id: str, chrome_pid: int, label: str = "") -> None:
+        self.trace_id = trace_id
+        self.chrome_pid = int(chrome_pid)
+        self.label = label or trace_id
+        self._stack: List[int] = []
+        self._next_id = 0
+
+    def _open_span(self) -> "tuple[int, Optional[int]]":
+        """Allocate a span id, returning ``(span_id, parent_id)``."""
+        parent = self._stack[-1] if self._stack else None
+        self._next_id += 1
+        span_id = self._next_id
+        self._stack.append(span_id)
+        return span_id, parent
+
+    def _close_span(self, span_id: int) -> None:
+        if self._stack and self._stack[-1] == span_id:
+            self._stack.pop()
+        elif span_id in self._stack:  # unbalanced exit: drop through it
+            while self._stack and self._stack.pop() != span_id:
+                pass
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id!r}, pid={self.chrome_pid})"
+
+
+#: Chrome pids for job contexts start here so they can never collide
+#: with a real process pid on the same timeline
+JOB_PID_BASE = 1_000_000
+
+_CTX = threading.local()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The calling thread's active trace context (``None`` outside a
+    served job)."""
+    return getattr(_CTX, "ctx", None)
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = getattr(_CTX, "ctx", None)
+    return ctx.trace_id if ctx is not None else None
+
+
+def set_current_context(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install ``ctx`` as the calling thread's trace context; returns
+    the previous one so callers can restore it (always-on and cheap: a
+    single thread-local assignment)."""
+    previous = getattr(_CTX, "ctx", None)
+    _CTX.ctx = ctx
+    return previous
+
+
+class _Span:
+    """A live span; appends one Chrome complete event on exit.
+
+    The span binds to the calling thread's :class:`TraceContext` at
+    entry — a coalesced dispatch executed on the dispatcher thread under
+    the job's re-activated context therefore records the job's pid and
+    parents under the worker-side span that submitted it.
+    """
+
+    __slots__ = (
+        "_tracer", "name", "cat", "args", "_start_ns", "_jax_ctx",
+        "_ctx", "_span_id", "_parent_id",
+    )
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: Dict):
         self._tracer = tracer
@@ -67,6 +161,12 @@ class _Span:
         if ann is not None:
             self._jax_ctx = ann(self.name)
             self._jax_ctx.__enter__()
+        ctx = current_context()
+        self._ctx = ctx
+        if ctx is not None:
+            self._span_id, self._parent_id = ctx._open_span()
+        else:
+            self._span_id = self._parent_id = None
         self._start_ns = time.perf_counter_ns()
         return self
 
@@ -74,6 +174,8 @@ class _Span:
         end_ns = time.perf_counter_ns()
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(*(exc or (None, None, None)))
+        if self._ctx is not None:
+            self._ctx._close_span(self._span_id)
         self._tracer._finish(self, self._start_ns, end_ns)
         return False
 
@@ -95,6 +197,7 @@ class Tracer:
         self._t0_ns = time.perf_counter_ns()
         self._jax_annotation = None  # set by enable_jax_bridge()
         self._pid = os.getpid()
+        self._named_pids: set = set()
 
     # -- enablement ----------------------------------------------------
 
@@ -134,21 +237,57 @@ class Tracer:
         return _Span(self, name, cat, args)
 
     def _finish(self, span: _Span, start_ns: int, end_ns: int) -> None:
+        ctx = span._ctx
         event = {
             "name": span.name,
             "cat": span.cat,
             "ph": "X",
             "ts": (start_ns - self._t0_ns) / 1e3,
             "dur": (end_ns - start_ns) / 1e3,
-            "pid": self._pid,
+            "pid": self._pid if ctx is None else ctx.chrome_pid,
             "tid": threading.get_ident() % 2**31,
         }
-        if span.args:
-            event["args"] = span.args
+        args = dict(span.args) if span.args else {}
+        if ctx is not None:
+            args["trace_id"] = ctx.trace_id
+            args["span_id"] = span._span_id
+            args["parent_id"] = span._parent_id
+        if args:
+            event["args"] = args
         dt = (end_ns - start_ns) / 1e9
         with self._lock:
+            if ctx is not None and ctx.chrome_pid not in self._named_pids:
+                self._named_pids.add(ctx.chrome_pid)
+                self._events.append({
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": ctx.chrome_pid,
+                    "args": {"name": ctx.label},
+                })
             self._events.append(event)
             self._totals[span.cat] = self._totals.get(span.cat, 0.0) + dt
+
+    def flow(self, phase: str, flow_id: int, name: str = "coalesce") -> None:
+        """Append a Chrome flow event (``phase`` ``"s"`` start on the
+        submitting thread, ``"f"`` finish on the executing thread) so the
+        worker→dispatcher hop renders as an arrow in Perfetto.  No-op
+        when tracing is disabled."""
+        if not self.enabled:
+            return
+        ctx = current_context()
+        event = {
+            "name": name,
+            "cat": "flow",
+            "ph": phase,
+            "id": int(flow_id),
+            "ts": (time.perf_counter_ns() - self._t0_ns) / 1e3,
+            "pid": self._pid if ctx is None else ctx.chrome_pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if phase == "f":
+            event["bp"] = "e"  # bind finish to enclosing slice
+        with self._lock:
+            self._events.append(event)
 
     # -- export --------------------------------------------------------
 
@@ -165,6 +304,7 @@ class Tracer:
         with self._lock:
             del self._events[:]
             self._totals.clear()
+            self._named_pids.clear()
 
     def write_chrome_trace(self, path: str, events: Optional[List[Dict]] = None) -> None:
         """Write a Chrome trace-event JSON file (Perfetto-loadable)."""

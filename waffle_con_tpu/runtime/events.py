@@ -40,13 +40,16 @@ def record(kind: str, **details) -> Dict:
     are on, so dropped events still show up in the registry totals.
     """
     event = {"kind": kind, **details}
+    dropped = False
     with _LOCK:
         if len(_EVENTS) < _MAX_EVENTS:
             _EVENTS.append(event)
         elif _EVENTS[-1].get("kind") == "event_log_saturated":
             _EVENTS[-1]["dropped"] += 1
+            dropped = True
         else:
             _EVENTS.append({"kind": "event_log_saturated", "dropped": 1})
+            dropped = True
     # lazy import: obs must stay import-light and cycle-free from here
     from waffle_con_tpu.obs import metrics as obs_metrics
 
@@ -54,6 +57,12 @@ def record(kind: str, **details) -> Dict:
         obs_metrics.registry().counter(
             "waffle_runtime_events_total", kind=kind
         ).inc()
+        if dropped:
+            # event loss is visible in the exposition, not only in the
+            # trailing saturation marker record
+            obs_metrics.registry().counter(
+                "waffle_runtime_events_dropped_total"
+            ).inc()
     return event
 
 

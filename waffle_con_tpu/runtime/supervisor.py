@@ -240,6 +240,13 @@ class BackendSupervisor(WavefrontScorer):
                 "waffle_backend_demotions_total",
                 from_backend=old, to_backend=target,
             )
+            from waffle_con_tpu.obs import flight, trace
+
+            flight.trigger(
+                "backend_demoted", trace_id=trace.current_trace_id(),
+                from_backend=old, to_backend=target,
+                handles=len(self._ledger), cause=repr(cause),
+            )
             logger.warning(
                 "demoting backend %s -> %s (%d live handles migrated): %r",
                 old, target, len(self._ledger), cause,

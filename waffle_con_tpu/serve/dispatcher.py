@@ -45,7 +45,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import slo as obs_slo
 from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.obs.instrument import TIMED_OPS
 from waffle_con_tpu.serve.job import ServiceClosed
@@ -74,7 +76,7 @@ def bucket_key(scorer) -> tuple:
 
 class _DispatchRequest:
     __slots__ = ("ticket", "bucket", "op", "fn", "result", "exception",
-                 "done")
+                 "done", "ctx", "enqueued_at")
 
     def __init__(self, ticket, bucket, op, fn) -> None:
         self.ticket = ticket
@@ -84,6 +86,12 @@ class _DispatchRequest:
         self.result = None
         self.exception: Optional[BaseException] = None
         self.done = threading.Event()
+        # the submitting worker's trace context rides along so the
+        # dispatcher thread can re-activate it around execution — the
+        # dispatch span then lands under the job's pid, parented by the
+        # worker-side search span (see obs/trace.py context contract)
+        self.ctx = obs_trace.current_context()
+        self.enqueued_at = time.perf_counter()
 
 
 class BatchingDispatcher:
@@ -185,6 +193,10 @@ class BatchingDispatcher:
                 self._stats["direct_dispatches"] += 1
             else:
                 req = _DispatchRequest(ticket, bucket, op, fn)
+                # flow start before the dispatcher can see the request,
+                # inside the worker's open search span, so the "s" event
+                # temporally precedes the dispatcher-side "f"
+                obs_trace.get_tracer().flow("s", id(req))
                 self._pending.append(req)
                 self._stats["routed_requests"] += 1
                 self._cond.notify_all()
@@ -194,7 +206,16 @@ class BatchingDispatcher:
                     "waffle_serve_direct_dispatches_total",
                     service=self._name,
                 ).inc()
-            return fn()
+            t0 = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                dt = time.perf_counter() - t0
+                obs_slo.observe_dispatch(dt)
+                obs_flight.record(
+                    "dispatch", trace_id=obs_trace.current_trace_id(),
+                    op=op, path="direct", total_ms=round(dt * 1e3, 3),
+                )
         # park until the dispatcher delivers; poll so a dispatcher that
         # died on an unexpected error cannot strand the worker forever
         while not req.done.wait(0.25):
@@ -262,6 +283,13 @@ class BatchingDispatcher:
                 bucket=str(bucket), occupancy=occupancy,
             ):
                 for req in reqs:
+                    # run under the submitting job's trace context: the
+                    # dispatch span gets the job's pid and parents under
+                    # the parked worker's search span (safe: that worker
+                    # is blocked on req.done until we set it)
+                    prev_ctx = obs_trace.set_current_context(req.ctx)
+                    obs_trace.get_tracer().flow("f", id(req))
+                    t0 = time.perf_counter()
                     try:
                         if req.ticket is not None:
                             req.ticket.check_abort(req.op)
@@ -269,6 +297,24 @@ class BatchingDispatcher:
                     except BaseException as exc:  # delivered to the worker
                         req.exception = exc
                     finally:
+                        dt = time.perf_counter() - t0
+                        obs_slo.observe_dispatch(
+                            time.perf_counter() - req.enqueued_at
+                        )
+                        obs_flight.record(
+                            "dispatch",
+                            trace_id=(req.ctx.trace_id
+                                      if req.ctx is not None else None),
+                            op=req.op, path="coalesced",
+                            occupancy=occupancy,
+                            exec_ms=round(dt * 1e3, 3),
+                            queue_ms=round(
+                                (t0 - req.enqueued_at) * 1e3, 3
+                            ),
+                            error=(repr(req.exception)
+                                   if req.exception is not None else None),
+                        )
+                        obs_trace.set_current_context(prev_ctx)
                         req.done.set()
 
     # -- introspection -------------------------------------------------

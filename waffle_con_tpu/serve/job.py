@@ -30,6 +30,7 @@ import time
 from typing import Any, Optional, Sequence, Tuple
 
 from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.obs.trace import JOB_PID_BASE, TraceContext
 from waffle_con_tpu.runtime.watchdog import enforce_deadline
 
 JOB_KINDS = ("single", "dual", "priority")
@@ -115,9 +116,19 @@ class JobRequest:
 class JobHandle:
     """Client-side handle and runtime-side abort ticket for one job."""
 
-    def __init__(self, job_id: int, request: JobRequest) -> None:
+    def __init__(
+        self, job_id: int, request: JobRequest, service: Optional[str] = None
+    ) -> None:
         self.job_id = job_id
         self.request = request
+        label = f"job-{job_id}"
+        if request.tag:
+            label += f" [{request.tag}]"
+        self.trace = TraceContext(
+            trace_id=f"{service or 'serve'}/job-{job_id}",
+            chrome_pid=JOB_PID_BASE + job_id,
+            label=label,
+        )
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._running = threading.Event()

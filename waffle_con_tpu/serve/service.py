@@ -25,10 +25,16 @@ queue with ``cancel_pending=True``.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
+from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import slo as obs_slo
+from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.runtime import events
 from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
 from waffle_con_tpu.serve.dispatcher import BatchingDispatcher, CoalescingScorer
@@ -190,7 +196,9 @@ class ConsensusService:
         with self._lock:
             if self._closed:
                 raise ServiceClosed("service is closed to new jobs")
-            handle = JobHandle(self._next_id, request)
+            handle = JobHandle(
+                self._next_id, request, service=self.config.name
+            )
             self._next_id += 1
         try:
             self._queue.put(handle)
@@ -200,6 +208,15 @@ class ConsensusService:
             events.record(
                 "serve_overloaded", job_kind=request.kind,
                 queue_limit=self.config.queue_limit,
+            )
+            # one incident per process for the whole storm (dedupe on
+            # reason), carrying the first rejected job's identity
+            obs_flight.trigger(
+                "service_overloaded",
+                rejected_trace_id=handle.trace.trace_id,
+                job_kind=request.kind,
+                queue_limit=self.config.queue_limit,
+                queue_depth=self._queue.depth(),
             )
             raise
         with self._lock:
@@ -220,10 +237,23 @@ class ConsensusService:
             # account it now that its heap entry has been consumed
             self._account(handle, "cancelled")
             return
+        # activate the job's trace context for everything the worker
+        # does on its behalf — spans land under the job's Chrome pid and
+        # the flight recorder can attribute records even with tracing
+        # off (always-on, one thread-local assignment)
+        prev_ctx = obs_trace.set_current_context(handle.trace)
+        obs_flight.record(
+            "job_start", trace_id=handle.trace.trace_id,
+            job_kind=handle.request.kind, job_id=handle.job_id,
+            queued_s=round(
+                time.monotonic() - handle.submitted_at, 6
+            ),
+        )
         try:
             handle.check_abort()  # deadline may already have lapsed
         except BaseException as exc:
             self._finalize(handle, exc)
+            obs_trace.set_current_context(prev_ctx)
             return
         self._dispatcher.job_started()
         dispatcher, ticket = self._dispatcher, handle
@@ -231,8 +261,12 @@ class ConsensusService:
             lambda scorer: CoalescingScorer(scorer, dispatcher, ticket)
         )
         try:
-            engine = _build_engine(handle.request)
-            result = engine.consensus()
+            with obs_trace.span(
+                "serve:job", "serve",
+                kind=handle.request.kind, job_id=handle.job_id,
+            ):
+                engine = _build_engine(handle.request)
+                result = engine.consensus()
         except BaseException as exc:
             self._finalize(handle, exc)
         else:
@@ -244,6 +278,7 @@ class ConsensusService:
         finally:
             set_scorer_decorator(previous)
             self._dispatcher.job_finished()
+            obs_trace.set_current_context(prev_ctx)
 
     def _finalize(self, handle: JobHandle, exc: BaseException) -> None:
         if isinstance(exc, JobCancelled):
@@ -259,13 +294,21 @@ class ConsensusService:
     def _account(self, handle: JobHandle, outcome: str) -> None:
         with self._lock:
             self._counts[outcome] += 1
+        latency = handle.latency_s
+        obs_flight.record(
+            "job_end", trace_id=handle.trace.trace_id,
+            outcome=outcome, job_id=handle.job_id,
+            latency_s=(round(latency, 6) if latency is not None else None),
+        )
+        if outcome == "done" and latency is not None:
+            obs_slo.observe_job(latency)
+        self._publish_stats()
         if obs_metrics.metrics_enabled():
             reg = obs_metrics.registry()
             reg.counter(
                 "waffle_serve_jobs_total",
                 service=self.config.name, outcome=outcome,
             ).inc()
-            latency = handle.latency_s
             if latency is not None:
                 reg.histogram(
                     "waffle_serve_job_latency_seconds",
@@ -274,6 +317,40 @@ class ConsensusService:
             reg.gauge(
                 "waffle_serve_active_jobs", service=self.config.name
             ).set(self._active_jobs())
+
+    def _publish_stats(self) -> None:
+        """When ``WAFFLE_STATS_FILE`` is set, atomically rewrite it with
+        the live stats + SLO snapshot (throttled) so ``waffle_top`` can
+        poll a serving process without a network endpoint."""
+        path = os.environ.get("WAFFLE_STATS_FILE", "")
+        if not path:
+            return
+        now = time.monotonic()
+        with self._lock:
+            last = getattr(self, "_stats_published_at", 0.0)
+            if now - last < 0.25:
+                return
+            self._stats_published_at = now
+        payload = {
+            "service": self.config.name,
+            "unix_time": time.time(),
+            "stats": self.stats(),
+            "slo": obs_slo.snapshot(),
+            "incidents": [
+                {k: i.get(k) for k in
+                 ("seq", "reason", "trace_id", "unix_time", "path")}
+                for i in obs_flight.incidents()[-8:]
+            ],
+        }
+        if obs_metrics.metrics_enabled():
+            payload["metrics"] = obs_metrics.registry().snapshot()
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, default=repr)
+            os.replace(tmp, path)
+        except OSError:  # a broken stats sink must never fail a job
+            pass
 
     def _active_jobs(self) -> int:
         with self._lock:

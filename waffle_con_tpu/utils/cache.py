@@ -153,6 +153,13 @@ def quarantine_corrupt_entries(path: str) -> list:
             changed = True
     if changed:
         _save_manifest(path, manifest)
+    if quarantined:
+        from waffle_con_tpu.obs import flight
+
+        flight.trigger(
+            "cache_quarantine", cache_dir=path,
+            entries=list(quarantined),
+        )
     return quarantined
 
 
