@@ -320,11 +320,14 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
     engine, tpu_results = tpu_run()
     compile_time = time.perf_counter() - compile_start
 
+    from waffle_con_tpu.ops.scorer import host_overlap_total
+
     if trace:
         import jax
 
         jax.profiler.start_trace(trace)
     tracer = _obs_setup(trace_out)
+    overlap0 = host_overlap_total()
     times = []
     reports = []
     slowest = (-1.0, None)
@@ -343,6 +346,16 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
 
     stats = getattr(engine, "last_search_stats", {})
     counters = stats.get("scorer_counters", {})
+    spec_cols = (
+        counters.get("run_spec_cols", 0)
+        + counters.get("run_dual_spec_cols", 0)
+    )
+    spec_committed = (
+        counters.get("run_steps", 0) + counters.get("run_dual_steps", 0)
+    )
+    spec_iters = (
+        counters.get("run_iters", 0) + counters.get("run_dual_iters", 0)
+    )
     dispatches = sum(
         counters.get(k, 0)
         for k in (
@@ -380,6 +393,11 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
             "grow_events": counters.get("grow_e_events", 0),
             "replayed_cols": counters.get("replayed_cols", 0),
             "initial_band": band,
+            "cols_per_iter": round(spec_cols / max(spec_iters, 1), 2),
+            "spec_commit_rate": round(
+                spec_committed / spec_cols, 4
+            ) if spec_cols else 1.0,
+            "host_overlap_s": round(host_overlap_total() - overlap0, 4),
             "nodes_explored": stats.get("nodes_explored", 0),
             "steps_per_s": round(
                 (counters.get("run_steps", 0) + counters.get("push_calls", 0))
@@ -400,14 +418,24 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
     cost of the lean device loop, so a device-loop regression cannot
     hide behind host-side wins (or vice versa).
 
+    Measures BOTH the K=1 baseline and the configured speculative
+    block size (``WAFFLE_RUN_COLS``); the configured-K number is the
+    gated metric, and the breakdown records ``cols_per_iter`` /
+    ``spec_commit_rate`` / ``host_overlap_s`` so the perf trajectory
+    shows *why* steps/s moved.
+
     Parity cross-check rides along for free: at 1% error and
     ``min_count = reads/4`` the whole sequence is one unambiguous run,
-    so the appended bytes must equal the generator's ground truth.
+    so the appended bytes must equal the generator's ground truth — at
+    every measured K.
     """
+    import os
+
     import numpy as np
 
     from waffle_con_tpu import CdwfaConfigBuilder
-    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer, _run_cols
+    from waffle_con_tpu.ops.scorer import host_overlap_total
     from waffle_con_tpu.utils.example_gen import generate_test
 
     min_count = max(2, num_reads // 4)
@@ -425,26 +453,58 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
 
     def engage():
         h = scorer.root(np.ones(num_reads, dtype=bool))
-        steps, code, appended, _stats, _recs = scorer.run_extend(
+        steps, code, appended, stats, _recs = scorer.run_extend(
             h, b"", budget, budget, 0, min_count, False, seq_len
         )
+        # force the deferred-sync fetch inside the timed window so the
+        # gated number includes the full result cost, not just control
+        stats.eds
         scorer.free(h)
         return steps, code, appended
 
-    compile_start = time.perf_counter()
-    steps, code, appended = engage()  # warm-up: compiles the run kernel
-    compile_time = time.perf_counter() - compile_start
-    parity = appended == truth
+    def measure(k):
+        """(steps/s, parity, commit_rate, steps, code, compile_s) at K=k."""
+        prev = os.environ.get("WAFFLE_RUN_COLS")
+        os.environ["WAFFLE_RUN_COLS"] = str(k)
+        try:
+            compile_start = time.perf_counter()
+            steps, code, appended = engage()  # warm-up compiles this K
+            compile_s = time.perf_counter() - compile_start
+            parity = appended == truth
+            it0 = scorer.counters["run_iters"]
+            sc0 = scorer.counters["run_spec_cols"]
+            st0 = scorer.counters["run_steps"]
+            best = None
+            for _ in range(max(1, iters)):
+                t0 = time.perf_counter()
+                steps, code, appended = engage()
+                dt = time.perf_counter() - t0
+                if best is None or dt < best:
+                    best = dt
+                parity = parity and appended == truth
+            spec = scorer.counters["run_spec_cols"] - sc0
+            commit_rate = (
+                (scorer.counters["run_steps"] - st0) / spec if spec else 1.0
+            )
+            cols_per_iter = spec / max(
+                scorer.counters["run_iters"] - it0, 1
+            )
+            return (
+                steps / max(best, 1e-9), parity, commit_rate,
+                cols_per_iter, steps, code, best, compile_s,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("WAFFLE_RUN_COLS", None)
+            else:
+                os.environ["WAFFLE_RUN_COLS"] = prev
 
-    best = None
-    for _ in range(max(1, iters)):
-        t0 = time.perf_counter()
-        steps, code, appended = engage()
-        dt = time.perf_counter() - t0
-        if best is None or dt < best:
-            best = dt
-        parity = parity and appended == truth
-    steps_per_s = steps / max(best, 1e-9)
+    cols = _run_cols()
+    overlap0 = host_overlap_total()
+    (base_sps, base_parity, _, _, _, _, _, base_compile_s) = measure(1)
+    (steps_per_s, parity, commit_rate, cols_per_iter, steps, code, best,
+     compile_time) = measure(cols)
+    parity = parity and base_parity
     return {
         "metric": f"microbench_run_extend_{num_reads}x{seq_len}_steps_per_s",
         "value": round(steps_per_s, 1),
@@ -455,8 +515,13 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
         "best_engagement_s": round(best, 4),
         "parity": bool(parity),
         "breakdown": {
-            "warmup_incl_compile_s": round(compile_time, 2),
+            "warmup_incl_compile_s": round(compile_time + base_compile_s, 2),
             "initial_band": band,
+            "run_cols": cols,
+            "steps_per_s_k1": round(base_sps, 1),
+            "cols_per_iter": round(cols_per_iter, 2),
+            "spec_commit_rate": round(commit_rate, 4),
+            "host_overlap_s": round(host_overlap_total() - overlap0, 4),
             "run_pallas_calls": scorer.counters.get("run_pallas_calls", 0),
             "runtime_events": _runtime_events(),
         },

@@ -17,6 +17,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Pin the speculative run-block size to 1 for the suite (tests that
+# exercise speculation set WAFFLE_RUN_COLS themselves — see the spec_*
+# tests in test_fuzz_parity.py; ci.sh re-runs the golden fixtures at
+# K>1 and the microbench gate runs at the production default). The
+# production default (K=4 on CPU) would recompile every jax test's
+# kernels with a 4x-unrolled loop body, multiplying the suite's
+# cold-cache compile time for zero coverage the explicit-K tests
+# don't already provide.
+os.environ.setdefault("WAFFLE_RUN_COLS", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

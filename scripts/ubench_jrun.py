@@ -1,19 +1,38 @@
 """Time the REAL ``_j_run`` kernel through the scorer at north-star
-shapes, isolating device per-step cost from engine/host overhead."""
+shapes, isolating device per-step cost from engine/host overhead.
+
+Two modes:
+
+  python scripts/ubench_jrun.py [STEPS] [BAND]
+      Single timing pass at the configured ``WAFFLE_RUN_COLS``.
+
+  python scripts/ubench_jrun.py --sweep [STEPS] [BAND]
+      Sweep the speculative block size K over {1, 2, 4, 8, 16},
+      checking byte parity of the appended consensus against K=1 and
+      emitting a JSON table of steps/s + commit rate per K.  This is
+      how the per-platform ``_RUN_COLS_DEFAULT`` values were chosen:
+      on a 1-core CPU host throughput plateaus from K=4 (~12% over
+      K=1; K=8/16 measure the same within noise while compile time
+      doubles per octave), and the TPU/GPU default of 4 is a
+      conservative carry-over pending on-device sweeps.
+"""
+import json
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
 
-import jax
 import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfigBuilder
 from waffle_con_tpu.ops.jax_scorer import JaxScorer
 from waffle_con_tpu.utils.example_gen import generate_test
 
-STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
-BAND = int(sys.argv[2]) if len(sys.argv) > 2 else 216
+argv = [a for a in sys.argv[1:] if a != "--sweep"]
+SWEEP = "--sweep" in sys.argv[1:]
+STEPS = int(argv[0]) if len(argv) > 0 else 2000
+BAND = int(argv[1]) if len(argv) > 1 else 216
 
 truth, reads = generate_test(4, 10_000, 256, 0.01, seed=0)
 cfg = (
@@ -22,7 +41,7 @@ cfg = (
 )
 sc = JaxScorer(reads, cfg)
 h = sc.root(np.ones(len(reads), dtype=bool))
-print(f"band E={sc.bucket_e} W={sc._W} R={len(reads)}")
+print(f"band E={sc.bucket_e} W={sc._W} R={len(reads)}", file=sys.stderr)
 
 
 def one():
@@ -31,18 +50,65 @@ def one():
         h, b"", me_budget=2**31 - 1, other_cost=2**31 - 1, other_len=0,
         min_count=64, l2=False, max_steps=STEPS,
     )
+    stats.eds  # force the deferred-sync fetch into the timed window
     dt = time.perf_counter() - t
-    return dt, steps, code
+    return dt, steps, code, appended
 
 
-dt, steps, code = one()  # compile + run
-print(f"warm-up: {dt:.2f}s steps={steps} code={code}")
-# fresh branch each time (run mutates the branch)
-for i in range(3):
-    sc.free(h)
-    h = sc.root(np.ones(len(reads), dtype=bool))
-    dt, steps, code = one()
-    print(
-        f"run {i}: {dt*1e3:8.1f} ms  steps={steps} code={code} "
-        f"{dt/max(steps,1)*1e6:7.2f} us/step"
-    )
+def timed_runs(n=3):
+    """Best-of-n fresh-branch engagements (run mutates the branch)."""
+    global h
+    best = None
+    for _ in range(n):
+        sc.free(h)
+        h = sc.root(np.ones(len(reads), dtype=bool))
+        dt, steps, code, appended = one()
+        if best is None or dt < best[0]:
+            best = (dt, steps, code, appended)
+    return best
+
+
+if SWEEP:
+    rows = []
+    baseline = None
+    for k in (1, 2, 4, 8, 16):
+        os.environ["WAFFLE_RUN_COLS"] = str(k)
+        sc.free(h)
+        h = sc.root(np.ones(len(reads), dtype=bool))
+        wdt, _, _, _ = one()  # warm-up compiles this K
+        it0, sp0, st0 = (
+            sc.counters["run_iters"], sc.counters["run_spec_cols"],
+            sc.counters["run_steps"],
+        )
+        dt, steps, code, appended = timed_runs()
+        if baseline is None:
+            baseline = appended
+        spec = sc.counters["run_spec_cols"] - sp0
+        rows.append({
+            "k": k,
+            "steps_per_s": round(steps / max(dt, 1e-9), 1),
+            "us_per_step": round(dt / max(steps, 1) * 1e6, 2),
+            "commit_rate": round(
+                (sc.counters["run_steps"] - st0) / spec, 4
+            ) if spec else 1.0,
+            "iters": sc.counters["run_iters"] - it0,
+            "compile_s": round(wdt, 2),
+            "parity_vs_k1": appended == baseline,
+            "stop_code": code,
+        })
+        print(f"K={k:2d}: {rows[-1]}", file=sys.stderr)
+    os.environ.pop("WAFFLE_RUN_COLS", None)
+    print(json.dumps({"sweep": rows, "steps": STEPS, "band": BAND}))
+    if not all(r["parity_vs_k1"] for r in rows):
+        sys.exit(1)
+else:
+    dt, steps, code, _ = one()  # compile + run
+    print(f"warm-up: {dt:.2f}s steps={steps} code={code}")
+    for i in range(3):
+        sc.free(h)
+        h = sc.root(np.ones(len(reads), dtype=bool))
+        dt, steps, code, _ = one()
+        print(
+            f"run {i}: {dt*1e3:8.1f} ms  steps={steps} code={code} "
+            f"{dt/max(steps,1)*1e6:7.2f} us/step"
+        )

@@ -1,0 +1,246 @@
+"""The async dispatch seam (``DeferredStats``): deferred bulk-stats
+fetches must stay invisible to every consumer.
+
+Covers the three composition boundaries the seam documents:
+
+* plain engine flow — ``run_extend`` returns a lazily-fetched
+  ``BranchStats`` whose arrays match the eager path bit-for-bit, and
+  the overlap accounting records the deferral window;
+* the supervisor — validation touches ``.eds``/``.split`` INSIDE the
+  retry/demote policy boundary, so an injected garbage-stats fault on a
+  deferred result is attributed to the right dispatch and replays
+  cleanly (byte-identical consensus);
+* the serve/coalescing path — a result crossing the dispatcher thread
+  boundary is materialized before delivery (deferral is only safe
+  while the consumer is the dispatching thread).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder, ConsensusDWFA
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.ops.scorer import (
+    BranchStats,
+    DeferredStats,
+    deferred_sync_enabled,
+    host_overlap_total,
+    resolve_stats,
+)
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.utils.example_gen import generate_test
+
+BUDGET = 2**31 - 1
+
+
+def _scorer(seed=0, n=6, seq_len=80):
+    truth, reads = generate_test(4, seq_len, n, 0.01, seed=seed)
+    cfg = (
+        CdwfaConfigBuilder().min_count(2).backend("jax").build()
+    )
+    return JaxScorer(reads, cfg), truth
+
+
+def _run(scorer, max_steps=64):
+    h = scorer.root(np.ones(len(scorer.reads), dtype=bool))
+    steps, code, appended, stats, _recs = scorer.run_extend(
+        h, b"", BUDGET, BUDGET, 0, 2, False, max_steps
+    )
+    return h, steps, code, appended, stats
+
+
+# ------------------------------------------------------------- the seam
+
+
+def test_env_knob_default_and_off(monkeypatch):
+    monkeypatch.delenv("WAFFLE_ASYNC_SYNC", raising=False)
+    assert deferred_sync_enabled()
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "0")
+    assert not deferred_sync_enabled()
+
+
+def test_run_extend_defers_and_matches_eager(monkeypatch):
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    scorer, _ = _scorer()
+    h, steps, code, appended, stats = _run(scorer)
+    assert isinstance(stats, DeferredStats)
+    assert isinstance(stats, BranchStats)  # duck-types everywhere
+    scorer.free(h)
+
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "0")
+    scorer2, _ = _scorer()
+    h2, steps2, code2, appended2, eager = _run(scorer2)
+    assert not isinstance(eager, DeferredStats)
+    assert (steps, code, appended) == (steps2, code2, appended2)
+    np.testing.assert_array_equal(stats.eds, eager.eds)
+    np.testing.assert_array_equal(stats.occ, eager.occ)
+    np.testing.assert_array_equal(stats.split, eager.split)
+    np.testing.assert_array_equal(stats.reached, eager.reached)
+    np.testing.assert_array_equal(stats.fin, eager.fin)
+
+
+def test_overlap_accounting_and_single_fetch(monkeypatch):
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    scorer, _ = _scorer(seed=1)
+    before = host_overlap_total()
+    h, *_rest, stats = _run(scorer)
+    assert stats._value is None  # nothing fetched yet
+    stats.eds  # first touch resolves...
+    mid = host_overlap_total()
+    assert mid > before  # ...and books the deferral window
+    stats.occ  # second touch reuses the materialized value
+    assert host_overlap_total() == mid
+    scorer.free(h)
+
+
+def test_deferred_setter_writes_through(monkeypatch):
+    """``faults.mangle_stats`` SETS ``.eds``/``.split`` on dispatch
+    results — the deferred proxy must resolve then write through."""
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    scorer, _ = _scorer(seed=2)
+    h, *_rest, stats = _run(scorer)
+    poison = np.full_like(stats.eds, 7)
+    stats.eds = poison
+    np.testing.assert_array_equal(stats.eds, poison)
+    np.testing.assert_array_equal(stats.resolve().eds, poison)
+    scorer.free(h)
+
+
+def test_resolve_stats_walks_containers(monkeypatch):
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    scorer, _ = _scorer(seed=3)
+    h, *_rest, stats = _run(scorer)
+    out = resolve_stats((1, "x", [stats], None))
+    assert stats._value is not None  # forced through the nesting
+    assert out[2][0] is stats  # structure unchanged
+    scorer.free(h)
+
+
+# ----------------------------------------------------------- supervisor
+
+
+def _consensus(reads, **kw):
+    b = CdwfaConfigBuilder().min_count(1).backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    e = ConsensusDWFA(b.build())
+    for r in reads:
+        e.add_sequence(r)
+    return [(c.sequence, c.scores) for c in e.consensus()]
+
+
+def test_supervisor_validates_deferred_stats_in_boundary(
+    faults, monkeypatch
+):
+    """An injected garbage-stats fault lands on a DEFERRED result: the
+    supervisor's validation must force the fetch inside its policy
+    boundary, attribute the failure to that dispatch, and replay it —
+    final consensus byte-identical to an unfaulted run."""
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    reads = (b"ACGTACGTACGT", b"ACGTACGTACGT", b"ACCTACGTACGT")
+    expected = _consensus(reads)
+    events.clear_events()
+    faults.add("garbage", backend="jax", op="stats", count=1)
+    got = _consensus(
+        reads,
+        backend_chain=("python",),
+        dispatch_retries=1,
+        breaker_threshold=2,
+        retry_backoff_s=0.0,
+    )
+    failed = events.get_events("dispatch_failed")
+    assert failed and "GarbageStats" in failed[0]["error"]
+    # the retry absorbed the fault: no demotion, byte-identical output
+    assert events.get_events("backend_demoted") == []
+    assert got == expected
+
+
+def test_supervisor_demotes_right_handle_with_deferral(faults, monkeypatch):
+    """Unlimited garbage faults exhaust retries and demote jax ->
+    python with live handles migrated — the deferred seam must not
+    smear the fault onto a later dispatch (wrong-handle demotion)."""
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    reads = (b"ACGTACGTACGT", b"ACGTACGTACGT", b"ACCTACGTACGT")
+    expected = _consensus(reads)
+    events.clear_events()
+    faults.add("garbage", backend="jax", op="stats", count=None)
+    got = _consensus(
+        reads,
+        backend_chain=("python",),
+        dispatch_retries=1,
+        breaker_threshold=2,
+        retry_backoff_s=0.0,
+    )
+    demotions = events.get_events("backend_demoted")
+    assert [(d["from_backend"], d["to_backend"]) for d in demotions] == [
+        ("jax", "python")
+    ]
+    assert got == expected
+
+
+# ------------------------------------------------------ serve coalescing
+
+
+def test_coalesced_dispatch_materializes_deferred_stats(monkeypatch):
+    """A deferred result delivered through the batching dispatcher's
+    worker handoff must be materialized ON the dispatcher thread — the
+    receiving worker never sees an unresolved fetch (fall-through)."""
+    from waffle_con_tpu.serve.dispatcher import BatchingDispatcher
+
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    scorer, _ = _scorer(seed=4)
+    disp = BatchingDispatcher(window_s=0.05, max_batch=4)
+    disp.start()
+    disp.job_started()
+    disp.job_started()  # >= 2 active jobs so dispatches coalesce
+    results = {}
+    try:
+        def worker(name):
+            def fn():
+                h, *_rest, stats = _run(scorer)
+                scorer.free(h)
+                return stats
+            results[name] = disp.dispatch(None, ("b",), "run", fn)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 2
+        for stats in results.values():
+            if isinstance(stats, DeferredStats):
+                assert stats._value is not None  # resolved pre-handoff
+        assert disp._stats["routed_requests"] >= 1
+    finally:
+        disp.job_finished()
+        disp.job_finished()
+        disp.close()
+
+
+def test_direct_dispatch_keeps_deferral(monkeypatch):
+    """A solo job falls through to direct same-thread dispatch — there
+    the deferral survives (the consumer IS the dispatching thread)."""
+    from waffle_con_tpu.serve.dispatcher import BatchingDispatcher
+
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "1")
+    scorer, _ = _scorer(seed=5)
+    disp = BatchingDispatcher(window_s=0.05, max_batch=4)
+    disp.start()
+    disp.job_started()  # alone: direct path
+    try:
+        def fn():
+            h, *_rest, stats = _run(scorer)
+            scorer.free(h)
+            return stats
+        stats = disp.dispatch(None, ("b",), "run", fn)
+        assert isinstance(stats, DeferredStats)
+        assert stats._value is None  # still lazy on the direct path
+        stats.eds  # and still resolvable
+    finally:
+        disp.job_finished()
+        disp.close()

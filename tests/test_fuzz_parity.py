@@ -318,6 +318,122 @@ def test_i16_band_state_fuzz(seed, monkeypatch):
     assert dual_engines[0].consensus() == dual_engines[1].consensus()
 
 
+# ---------------------------------------------------------------------------
+# Speculative K-column stepping (WAFFLE_RUN_COLS): the device while-loop
+# processes K columns per iteration, re-verifying in-kernel and freezing on
+# the first stop code — the contract is BYTE-IDENTICAL results to K=1 for
+# every K, regardless of where within a K-block the stop lands.
+# ---------------------------------------------------------------------------
+
+
+def _single_result(reads, k, monkeypatch, min_count=2, backend="jax"):
+    monkeypatch.setenv("WAFFLE_RUN_COLS", str(k))
+    e = ConsensusDWFA(
+        _cfg(backend, np.random.default_rng(0), min_count=min_count)
+    )
+    for r in reads:
+        e.add_sequence(r)
+    return [(c.sequence, c.scores) for c in e.consensus()]
+
+
+@pytest.mark.parametrize("offset", range(4))
+def test_spec_block_divergence_every_offset(offset, monkeypatch):
+    """Force the stop to land at EVERY offset within a K=4 block: the
+    stopping step is pinned by the sequence length, so sweeping four
+    consecutive lengths walks the stop across all in-block positions.
+    The committed prefix must be byte-identical to K=1 and the oracle
+    at each offset (rollback-at-offset-0 is the offset=0 case)."""
+    seq_len = 96 + offset
+    truth, reads = generate_test(4, seq_len, 6, 0.01, seed=26000 + offset)
+    want = _single_result(reads, 1, monkeypatch, backend="python")
+    base = _single_result(reads, 1, monkeypatch)
+    spec = _single_result(reads, 4, monkeypatch)
+    assert base == want
+    assert spec == base
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_spec_block_boundary_near_tie(seed, monkeypatch):
+    """Near-tie votes pinned AT a K-block boundary: positions K-1, K,
+    K+1 of a block edge are flipped in exactly half the reads, so the
+    host arbitration stop lands on the boundary and the speculative
+    block must roll back without committing a single phantom column."""
+    rng = np.random.default_rng(27000 + seed)
+    K = 4
+    seq_len = 120
+    n = 6
+    truth, reads = generate_test(4, seq_len, n, 0.0, seed=28000 + seed)
+    reads = [bytearray(r) for r in reads]
+    edge = K * int(rng.integers(8, 20))
+    for pos in (edge - 1, edge, edge + 1):
+        alt = (truth[pos] + 1 + int(rng.integers(3))) % 4
+        for i in range(n // 2):
+            reads[i][pos] = alt
+    reads = [bytes(r) for r in reads]
+    want = _single_result(
+        reads, 1, monkeypatch, min_count=n // 2, backend="python"
+    )
+    base = _single_result(reads, 1, monkeypatch, min_count=n // 2)
+    spec = _single_result(reads, K, monkeypatch, min_count=n // 2)
+    assert base == want
+    assert spec == base
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_spec_reached_end_mid_block(seed, monkeypatch):
+    """Staggered exact-prefix reads whose baselines end at non-multiples
+    of K: the fused reached-end absorption fires MID speculative block,
+    and the band can grow in-block on the survivors — both must leave
+    the committed prefix byte-identical to K=1."""
+    rng = np.random.default_rng(29000 + seed)
+    K = 4
+    seq_len = 140
+    truth, reads = generate_test(4, seq_len, 5, 0.01, seed=30000 + seed)
+    reads = list(reads)
+    for frac in (0.3, 0.55, 0.8):
+        cut = int(seq_len * frac)
+        cut += (K - cut % K) % K + 1 + int(rng.integers(0, K - 1))
+        reads.append(truth[:cut])  # baseline ends mid-block by design
+    want = _single_result(reads, 1, monkeypatch, min_count=3, backend="python")
+    base = _single_result(reads, 1, monkeypatch, min_count=3)
+    spec = _single_result(reads, K, monkeypatch, min_count=3)
+    assert base == want
+    assert spec == base
+
+
+def test_spec_i16_single_and_dual(monkeypatch):
+    """Forced int16 band state combined with K>1 speculation (an odd K
+    that never divides the stop step evenly), single AND dual: the
+    narrowed kernels' freeze masking must stay bit-identical to K=1."""
+    monkeypatch.setenv("WAFFLE_XLA_I16", "1")
+    rng = np.random.default_rng(31000)
+    seq_len = 110
+    n = 6
+    truth, reads = generate_test(4, seq_len, n, 0.01, seed=32000)
+    base = _single_result(reads, 1, monkeypatch)
+    spec = _single_result(reads, 5, monkeypatch)
+    assert spec == base
+
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    dual_reads = list(reads) + [
+        corrupt(bytes(h2), 0.01, np.random.default_rng(33000 + i))
+        for i in range(n)
+    ]
+
+    def dual_at(k):
+        monkeypatch.setenv("WAFFLE_RUN_COLS", str(k))
+        e = DualConsensusDWFA(
+            _cfg("jax", np.random.default_rng(0), min_count=2)
+        )
+        for r in dual_reads:
+            e.add_sequence(r)
+        return e.consensus()
+
+    assert dual_at(5) == dual_at(1)
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_priority_chain_fuzz(seed):
     """Two-level chains with a level-1 split: the priority engine's

@@ -64,7 +64,12 @@ from jax import lax
 
 from waffle_con_tpu.config import CdwfaConfig
 from waffle_con_tpu.obs.trace import span as _obs_span
-from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
+from waffle_con_tpu.ops.scorer import (
+    BranchStats,
+    DeferredStats,
+    WavefrontScorer,
+    deferred_sync_enabled,
+)
 
 #: Numpy (not jnp) module constants: a ``jnp`` scalar here would (a) force
 #: backend init at import time and (b) on this platform every eagerly
@@ -90,6 +95,35 @@ REC_CAP = 256
 DINF16 = np.int32(30000)
 
 logger = logging.getLogger(__name__)
+
+#: default speculative block width (columns per device ``while_loop``
+#: iteration) per JAX backend, chosen by ``scripts/ubench_jrun.py --sweep``
+#: measurement: on XLA:CPU the per-iteration fixed cost (loop condition,
+#: buffer rotation, per-op launch latency of the body's small fused
+#: kernels) dominates the [R, W] column math, so unrolling K columns into
+#: one iteration amortizes it almost linearly until compile time and
+#: masked-tail waste push back.  The north-star sweep measured a
+#: plateau from K=4 (951 -> 1063 steps/s at K=4; 1053 at K=8; 1056 at
+#: K=16) with compile time still doubling per octave, so the default
+#: sits at the knee.  Override with ``WAFFLE_RUN_COLS``.
+_RUN_COLS_DEFAULT = {"cpu": 4, "tpu": 4, "gpu": 4}
+
+_RUN_COLS_MAX = 64
+
+
+def _run_cols() -> int:
+    """Speculative columns per device loop iteration (the K knob).
+
+    Read per run call so tests can flip ``WAFFLE_RUN_COLS`` at runtime
+    (each distinct K is a static argument — its own compiled kernel).
+    K=1 compiles to the pre-speculation single-column kernel."""
+    env = os.environ.get("WAFFLE_RUN_COLS")
+    if env:
+        try:
+            return max(1, min(_RUN_COLS_MAX, int(env)))
+        except ValueError:
+            return 1
+    return _RUN_COLS_DEFAULT.get(jax.default_backend(), 1)
 
 
 def _xla_i16_ok(L: int, C: int, W: int) -> bool:
@@ -734,11 +768,11 @@ def _nominate_side(occ, split, w, wc, weighted, mc_tab, mc_dyn):
 
 @partial(
     jax.jit,
-    static_argnames=("num_symbols", "uniform", "a_real", "i16"),
+    static_argnames=("num_symbols", "uniform", "a_real", "i16", "cols"),
     donate_argnums=(0,),
 )
 def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
-           uniform, a_real=None, i16=False):
+           uniform, a_real=None, i16=False, cols=1):
     """Device-resident multi-symbol extension: keep appending the unique
     passing candidate while the votes are exactly reproducible host-side
     (one tip symbol per read → integer counts), stopping at any event the
@@ -810,6 +844,23 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     band state to int16 for the whole loop — converted once at loop
     entry/exit, never per step — halving the hot ``[R, W]`` traffic.
     Both are value-exact: results are bit-identical to the wide path.
+
+    ``cols`` (static, the ``WAFFLE_RUN_COLS`` knob) is the SPECULATIVE
+    BLOCK WIDTH: each ``while_loop`` iteration runs ``cols`` copies of
+    the single-column sub-step back to back, re-verifying the vote after
+    every column.  Sub-column 0 is exactly the K=1 body; sub-columns
+    1..K-1 carry the running stop code and mask their commit on it, so a
+    stop anywhere in the block freezes the remaining columns into
+    no-ops — the committed prefix, the sticking stop code, the record
+    buffer, and the band state are bit-identical to stepping one column
+    at a time (rollback is free: uncommitted column state is simply
+    never selected).  The win is amortization: loop-condition
+    evaluation, carry rotation, and the per-iteration launch overhead of
+    the body's many tiny fused kernels are paid once per K columns
+    instead of once per column.  ``cols=1`` compiles to the
+    pre-speculation kernel.  The extra return value ``iters`` counts
+    loop iterations so the host can report speculated columns
+    (``iters * cols``) vs committed (``steps``).
     """
     h = params[0]
     me_budget = params[1]
@@ -849,9 +900,13 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
             D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
         )
 
-    def body(carry):
+    def substep(carry, masked):
         (D, e, rmin, er, cons, clen, steps, budget,
          rec_count, rec_steps, rec_fins, _code) = carry
+        # note: the stats snapshot at ``clen`` and the push to ``clen + 1``
+        # read the SAME [R, W] read window (stats index ``clen - off - E + t``
+        # equals the push's ``i_new - 1``); XLA CSEs the duplicate fetch, so
+        # the two helper calls cost one gather/slice per column
         eds, occ, split, reached = stats_at(D, e, rmin, er, clen, pad=False)
         # finalized snapshot of THIS (pre-push) state: the host records it
         # at this pop; absorbing the record needs it in-band.  Inlined
@@ -963,6 +1018,12 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         ovf = (act & (e2 >= E)).any()
         commit = (code == 0) & ~ovf
         code = jnp.where(code != 0, code, jnp.where(ovf, 5, 0))
+        if masked:
+            # speculative sub-column: a stop earlier in the block turns
+            # this column into a no-op — nothing commits and the FIRST
+            # stop code sticks, so the block is bit-identical to K=1
+            commit = commit & (_code == 0)
+            code = jnp.where(_code != 0, _code, code)
         # record of the popped state, buffered only when the step commits
         # (a stopped state is recorded by the host's own completion path)
         do_rec = commit & reached_here
@@ -989,6 +1050,15 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         steps = steps + commit.astype(steps.dtype)
         return (D, e, rmin, er, cons, clen, steps, budget,
                 rec_count, rec_steps, rec_fins, code)
+
+    def body(carry):
+        # speculative K-column block: sub-column 0 is the exact K=1 body
+        # (the loop condition guarantees code==0 here); the rest verify
+        # the running code before committing
+        sub = substep(carry[:-1], masked=False)
+        for _ in range(cols - 1):
+            sub = substep(sub, masked=True)
+        return sub + (carry[-1] + 1,)
 
     D0 = state["D"][h]
     if i16:
@@ -1035,9 +1105,10 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
         jnp.zeros((REC_CAP,), jnp.int32),
         jnp.zeros((REC_CAP, R), jnp.int32),
         code0,
+        jnp.int32(0),
     )
     (D, e, rmin, er, cons, clen, steps, _budget,
-     rec_count, rec_steps, rec_fins, code) = lax.while_loop(
+     rec_count, rec_steps, rec_fins, code, iters) = lax.while_loop(
         lambda c: c[11] == 0, body, init
     )
     if i16:  # widen back, restoring the INF sentinel
@@ -1054,7 +1125,7 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     out["clen"] = state["clen"].at[h].set(clen)
     return (
         out, steps, code, stats, cons, fin_eds, fin_ovf,
-        rec_count, rec_steps, rec_fins,
+        rec_count, rec_steps, rec_fins, iters,
     )
 
 
@@ -1092,11 +1163,12 @@ def _dual_votes(occ, split, w, wc, weighted):
 
 @partial(
     jax.jit,
-    static_argnames=("num_symbols", "uniform", "a_real", "i16"),
+    static_argnames=("num_symbols", "uniform", "a_real", "i16", "cols"),
     donate_argnums=(0,),
 )
 def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
-                wc, et, num_symbols, uniform, a_real=None, i16=False):
+                wc, et, num_symbols, uniform, a_real=None, i16=False,
+                cols=1):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
     with divergence pruning (``dual_max_ed_delta``) applied on device
@@ -1149,6 +1221,11 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
     ``full_min_count`` (``max(min_count, ceil(min_af * n))``): the
     record-acceptance imbalance threshold, which only shrinks the
     running budget when the host would also have accepted the record.
+
+    ``cols`` (static): speculative block width — K single-column
+    sub-steps per ``while_loop`` iteration with commit masking on the
+    running stop code, bit-identical to K=1 (see ``_j_run``).  The
+    extra return value ``iters`` counts loop iterations.
     """
     ha = params[0]
     hb = params[1]
@@ -1198,7 +1275,7 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
             D, e, rmin, er, off, act, rlen, reads, jnew, sym, wc, et, E
         )
 
-    def body(carry):
+    def substep(carry, masked):
         (Da, ea, rmina, era, acta, consa, clena,
          Db, eb, rminb, erb, actb, consb, clenb, steps, budget,
          rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
@@ -1359,6 +1436,11 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
             code,
             jnp.where(ovf, 5, jnp.where(imb, 6, 0)),
         )
+        if masked:
+            # speculative sub-column (see _j_run): a stop earlier in the
+            # block freezes this column and the first stop code sticks
+            commit = commit & (_code == 0)
+            code = jnp.where(_code != 0, _code, code)
         # buffer the popped state's record on commit (the stopped state
         # is recorded by the host's own completion path), and shrink the
         # running budget exactly as an accepted record would
@@ -1401,6 +1483,13 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
                 rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
                 code)
 
+    def body(carry):
+        # speculative K-column block (see _j_run)
+        sub = substep(carry[:-1], masked=False)
+        for _ in range(cols - 1):
+            sub = substep(sub, masked=True)
+        return sub + (carry[-1] + 1,)
+
     R = rlen.shape[0]
     Da0 = state["D"][ha]
     Db0 = state["D"][hb]
@@ -1420,11 +1509,12 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
         jnp.zeros((REC_CAP, R), bool),
         jnp.zeros((REC_CAP, R), bool),
         jnp.int32(0),
+        jnp.int32(0),
     )
     (Da, ea, rmina, era, acta, consa, clena,
      Db, eb, rminb, erb, actb, consb, clenb, steps, _budget,
      rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
-     code) = lax.while_loop(
+     code, iters) = lax.while_loop(
         lambda c: c[22] == 0, body, init
     )
     if i16:  # widen back, restoring the INF sentinel
@@ -1444,7 +1534,7 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
     out["clen"] = state["clen"].at[ha].set(clena).at[hb].set(clenb)
     return (
         out, steps, code, stats_a, stats_b, acta, actb, consa, consb,
-        rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2,
+        rec_count, rec_steps, rec_f1, rec_f2, rec_a1, rec_a2, iters,
     )
 
 
@@ -1457,13 +1547,15 @@ CRE_PER_EVENT = 8
 
 @partial(
     jax.jit,
-    static_argnames=("num_symbols", "max_steps", "K", "uniform", "a_real"),
+    static_argnames=(
+        "num_symbols", "max_steps", "K", "uniform", "a_real", "cols"
+    ),
     donate_argnums=(0,),
 )
 def _j_arena(
     state, reads, reads_pad, rlen, params, slots, kinds0, seqv0, off0s0,
     tr_scalars, lc0, pc0, mc_tab, imb_tab, wc, et, num_symbols, max_steps,
-    K, uniform, a_real=None,
+    K, uniform, a_real=None, cols=1,
 ):
     """K-node pop ARENA: resolve the pop competition among the K best
     runnable queue entries entirely on device.
@@ -1685,11 +1777,14 @@ def _j_arena(
             jnp.stack([nt1, nt2]),
         )
 
-    def body(carry):
+    def substep(carry, masked):
         (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
          lc, pc, tr, steps, hist, nsteps, seqv, fresh, alive, seq_ctr,
          pool_next, cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2,
          cre_len, _diag, _code, _stop_node) = carry
+        # the tracker constriction below mutates tr unconditionally, so a
+        # frozen speculative sub-step must restore it at the end
+        tr_in = tr
 
         is_dual = kinds == 1
         eds, occ, split, reached = stats_all(
@@ -1898,6 +1993,13 @@ def _j_arena(
                 ),
             ),
         )
+        if masked:
+            # speculative sub-step (see _j_run): a stop earlier in the
+            # block freezes the arena — no event of any kind (commit,
+            # discard, split) may fire, and the first stop code sticks
+            discard_now = discard_now & (_code == 0)
+            want_split = want_split & (_code == 0)
+            code = jnp.where(_code != 0, _code, code)
 
         # ---- child creation, under lax.cond so the staged column
         # pushes (2 per child slot) only execute on actual split events
@@ -2265,12 +2367,30 @@ def _j_arena(
             split_commit, n_children, commit.astype(jnp.int32)
         )
         stop_node = win
+        if masked:
+            # frozen sub-step: keep the stopping sub-step's tracker state
+            # and stop diagnostics (every other write above is gated on
+            # commit/discard_now/split_commit, all False once _code != 0)
+            frozen = _code != 0
+            tr = jnp.where(frozen, tr_in, tr)
+            stop_diag = jnp.where(frozen, _diag, stop_diag)
+            stop_node = jnp.where(frozen, _stop_node, stop_node)
         return (
             D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
             lc, pc, tr, steps, hist, nsteps, seqv, fresh, alive, seq_ctr,
             pool_next, cre_count, cre_parent, cre_kind, cre_sym1,
             cre_sym2, cre_len, stop_diag, code, stop_node,
         )
+
+    def body(carry):
+        # speculative multi-event block (see _j_run): sub-step 0 is the
+        # exact single-event body (the loop condition guarantees code==0
+        # there); later sub-steps freeze as soon as a stop code appears,
+        # so the block is bit-identical to cols=1
+        sub = substep(carry[:-1], masked=False)
+        for _ in range(cols - 1):
+            sub = substep(sub, masked=True)
+        return sub + (carry[-1] + 1,)
 
     init = (
         state["D"][slots],
@@ -2303,11 +2423,12 @@ def _j_arena(
         jnp.int32(0),              # stop_diag
         jnp.int32(0),
         jnp.int32(0),
+        jnp.int32(0),              # iters (while iterations)
     )
     (D, e, rmin, er, act, cons, clen, offs, off0s, kinds,
      _lc, _pc, _tr, steps, hist, nsteps, _seqv, _fresh, alive, _ctr,
      _pool, cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len,
-     stop_diag, code, stop_node) = lax.while_loop(
+     stop_diag, code, stop_node, iters) = lax.while_loop(
         lambda c: c[28] == 0, body, init
     )
 
@@ -2332,7 +2453,7 @@ def _j_arena(
         out, hist, nsteps, code, stop_node, steps,
         (eds, occ, split, reached), act, cons, clen, alive,
         cre_count, cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len,
-        stop_diag,
+        stop_diag, iters,
     )
 
 
@@ -2527,8 +2648,14 @@ class JaxScorer(WavefrontScorer):
             "push_branches": 0,
             "run_calls": 0,
             "run_steps": 0,
+            "run_iters": 0,
+            "run_spec_cols": 0,
             "run_dual_calls": 0,
             "run_dual_steps": 0,
+            "run_dual_iters": 0,
+            "run_dual_spec_cols": 0,
+            "arena_iters": 0,
+            "arena_spec_events": 0,
             "stats_calls": 0,
             "clone_calls": 0,
             "activate_calls": 0,
@@ -3037,18 +3164,26 @@ class JaxScorer(WavefrontScorer):
             else:
                 (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
                  rec_count, rec_steps, rec_fins) = out
+                iters, cols = steps, 1  # fused kernel: one col per iter
         if not use_pallas:
+            cols = _run_cols()
             (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
-             rec_count, rec_steps, rec_fins) = _j_run(
+             rec_count, rec_steps, rec_fins, iters) = _j_run(
                 self._state, self._reads, self._reads_pad, self._rlen,
                 params, self._wc, self._et, self._A, uniform,
-                a_real=self.num_symbols, i16=self._xla_i16(),
+                a_real=self.num_symbols, i16=self._xla_i16(), cols=cols,
             )
         self._state = state
+        defer = deferred_sync_enabled()
         with _obs_span("device_get:run_extend", "device-sync"):
-            (steps, code, stats_np, cons_np, fin_np, fin_ovf,
-             rec_count) = jax.device_get(
-                (steps, code, stats, cons_row, fin_eds, fin_ovf, rec_count)
+            # async dispatch seam: only the CONTROL results the engine's
+            # bookkeeping needs right now cross the device boundary here;
+            # the bulk observation arrays ride a DeferredStats and are
+            # fetched when the branch is next popped — the bookkeeping
+            # for this run (and the dispatch of the next) overlaps the
+            # outstanding transfer (see ops.scorer.DeferredStats)
+            (steps, code, cons_np, rec_count, iters) = jax.device_get(
+                (steps, code, cons_row, rec_count, iters)
             )
             # the record buffers only ride home when something was
             # absorbed (most run calls have none, and every fetched byte
@@ -3057,10 +3192,15 @@ class JaxScorer(WavefrontScorer):
                 rec_steps_np, rec_fins_np = jax.device_get(
                     (rec_steps, rec_fins)
                 )
+            stats_parts = (stats, fin_eds, fin_ovf)
+            if not defer:
+                stats_parts = jax.device_get(stats_parts)
         steps = int(steps)
         code = int(code)
         self.counters["run_calls"] += 1
         self.counters["run_steps"] += steps
+        self.counters["run_iters"] += int(iters)
+        self.counters["run_spec_cols"] += int(iters) * cols
         key = f"run_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
         appended = b""
@@ -3074,9 +3214,20 @@ class JaxScorer(WavefrontScorer):
             (int(rec_steps_np[i]), rec_fins_np[i, :n].astype(np.int64))
             for i in range(int(rec_count))
         ]  # rec_count == 0 -> empty without touching the buffers
-        return steps, code, appended, self._stats_np(
-            stats_np + (fin_np, np.logical_not(fin_ovf))
-        ), records
+
+        def build_stats(parts):
+            s4, fin_np, fovf = parts[0], parts[1], parts[2]
+            return self._stats_np(
+                tuple(s4) + (fin_np, np.logical_not(fovf))
+            )
+
+        if defer:
+            out_stats: BranchStats = DeferredStats(
+                lambda: build_stats(jax.device_get(stats_parts))
+            )
+        else:
+            out_stats = build_stats(stats_parts)
+        return steps, code, appended, out_stats, records
 
     def run_extend_dual(
         self,
@@ -3179,31 +3330,42 @@ class JaxScorer(WavefrontScorer):
                 (state, steps, code, stats1, stats2, act1, act2, consa,
                  consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
                  rec_a2) = out
+                iters, cols = steps, 1  # fused kernel: one col per iter
         if not use_pallas:
+            cols = _run_cols()
             (state, steps, code, stats1, stats2, act1, act2, consa,
              consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
-             rec_a2) = _j_run_dual(
+             rec_a2, iters) = _j_run_dual(
                 self._state, self._reads, self._reads_pad, self._rlen,
                 params, np.ascontiguousarray(mc_tab, dtype=np.int32),
                 imb_tab, self._wc, self._et, self._A, uni1 and uni2,
-                a_real=self.num_symbols, i16=self._xla_i16(),
+                a_real=self.num_symbols, i16=self._xla_i16(), cols=cols,
             )
         self._state = state
+        defer = deferred_sync_enabled()
         with _obs_span("device_get:run_extend_dual", "device-sync"):
-            (steps, code, stats1_np, stats2_np, act1_np, act2_np,
-             consa_np, consb_np, rec_count) = jax.device_get(
-                (steps, code, stats1, stats2, act1, act2, consa, consb,
-                 rec_count)
+            # async dispatch seam (see run_extend): control results now,
+            # per-side observation arrays deferred.  The act masks are
+            # control — the host act mirror must update before the next
+            # dispatch touches these branches.
+            (steps, code, act1_np, act2_np,
+             consa_np, consb_np, rec_count, iters) = jax.device_get(
+                (steps, code, act1, act2, consa, consb,
+                 rec_count, iters)
             )
             if int(rec_count):
                 (rec_steps_np, rec_f1_np, rec_f2_np, rec_a1_np,
                  rec_a2_np) = jax.device_get(
                     (rec_steps, rec_f1, rec_f2, rec_a1, rec_a2)
                 )
+            if not defer:
+                stats1, stats2 = jax.device_get((stats1, stats2))
         steps = int(steps)
         code = int(code)
         self.counters["run_dual_calls"] += 1
         self.counters["run_dual_steps"] += steps
+        self.counters["run_dual_iters"] += int(iters)
+        self.counters["run_dual_spec_cols"] += int(iters) * cols
         key = f"run_dual_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
 
@@ -3233,13 +3395,23 @@ class JaxScorer(WavefrontScorer):
         self._act_host[s2] = act2_np
         if code == 5:
             self._grow_e()
+        if defer:
+            out1: BranchStats = DeferredStats(
+                lambda: self._stats_np(jax.device_get(stats1))
+            )
+            out2: BranchStats = DeferredStats(
+                lambda: self._stats_np(jax.device_get(stats2))
+            )
+        else:
+            out1 = self._stats_np(stats1)
+            out2 = self._stats_np(stats2)
         return (
             steps,
             code,
             app1,
             app2,
-            self._stats_np(stats1_np),
-            self._stats_np(stats2_np),
+            out1,
+            out2,
             act1_np[:n],
             act2_np[:n],
             records,
@@ -3402,9 +3574,15 @@ class JaxScorer(WavefrontScorer):
             dtype=np.int32,
         )
         seqv0 = np.arange(K, dtype=np.int32)
+        # the arena body is an order of magnitude bigger than the run
+        # kernels (pop tournament + tracker loops + creation cond), so
+        # the speculative unroll is capped low: XLA:CPU has crashed
+        # compiling large unrolled arena graphs before (see the
+        # tournament comment in _j_arena)
+        cols = min(_run_cols(), 4)
         (state, hist, nsteps, code, stop_node, steps, stats, act, cons,
          clen, alive, cre_count, cre_parent, cre_kind, cre_sym1,
-         cre_sym2, cre_len, stop_diag) = (
+         cre_sym2, cre_len, stop_diag, iters) = (
             _j_arena(
                 self._state,
                 self._reads,
@@ -3427,15 +3605,19 @@ class JaxScorer(WavefrontScorer):
                 K,
                 uniform,
                 a_real=self.num_symbols,
+                cols=cols,
             )
         )
         self._state = state
         with _obs_span("device_get:run_arena", "device-sync"):
             (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
-             cons_np, alive_np, cre_count, stop_diag) = jax.device_get(
+             cons_np, alive_np, cre_count, stop_diag,
+             iters) = jax.device_get(
                 (hist, nsteps, code, stop_node, steps, stats, act, cons,
-                 alive, cre_count, stop_diag)
+                 alive, cre_count, stop_diag, iters)
             )
+        self.counters["arena_iters"] += int(iters)
+        self.counters["arena_spec_events"] += int(iters) * cols
         nsteps = int(nsteps)
         code = int(code)
         stop_node = int(stop_node)

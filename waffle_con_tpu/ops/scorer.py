@@ -26,7 +26,9 @@ cf. ``/root/reference/src/consensus.rs:546-552``).
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -61,6 +63,121 @@ class BranchStats:
         self.split = split
         self.reached = reached
         self.fin = fin
+
+
+class DeferredStats(BranchStats):
+    """A :class:`BranchStats` whose bulk arrays have not crossed the
+    device boundary yet — the async dispatch seam.
+
+    Device run calls return two kinds of results: *control* scalars
+    (steps, stop code, appended symbols) the engine needs immediately
+    for its pop/constrict/insert bookkeeping, and *bulk* observation
+    arrays (eds/occ/split/reached/fin) it only reads at the branch's
+    NEXT pop.  Wrapping the bulk half in a ``DeferredStats`` lets the
+    scorer skip that part of ``block_until_ready``/``device_get`` at
+    dispatch time: the transfer + numpy conversion happen lazily on
+    first field access, and everything the host did in between —
+    bookkeeping for run *i*, queue work, even dispatching run *i+1* —
+    overlapped with it.  The elapsed creation→resolution time is
+    accounted as ``host_overlap_s`` (:func:`host_overlap_total`).
+
+    Composition rules (the seam's safety contract):
+
+    * the supervisor's dispatch validation touches ``eds``/``occ``/
+      ``split``, so a supervised dispatch resolves INSIDE the policy
+      boundary — timeouts, garbage injection, and demotion attribute to
+      the right dispatch (resolution later than the boundary would blame
+      the wrong one);
+    * the serve-path ``CoalescingScorer`` calls :func:`resolve_stats`
+      before results cross the dispatcher→worker thread hop, falling
+      through to fully synchronous semantics when coalescing is active;
+    * everything else duck-types as a plain :class:`BranchStats`
+      (``isinstance`` included) and resolves transparently.
+    """
+
+    __slots__ = ("_fetch", "_value", "_t0")
+
+    def __init__(self, fetch) -> None:
+        # no super().__init__: the parent's slot storage stays unused and
+        # every field access routes through the properties below
+        self._fetch = fetch
+        self._value: Optional[BranchStats] = None
+        self._t0 = time.perf_counter()
+
+    def resolve(self) -> BranchStats:
+        """Force the device fetch; idempotent."""
+        if self._value is None:
+            _note_overlap(time.perf_counter() - self._t0)
+            self._value = self._fetch()
+            self._fetch = None
+        return self._value
+
+    # field access resolves; assignment (the fault injector's garbage
+    # payload mutates stats in place) resolves then writes through
+    def _get(name):  # noqa: N805 - descriptor factory, not a method
+        def getter(self):
+            return getattr(self.resolve(), name)
+
+        def setter(self, value):
+            setattr(self.resolve(), name, value)
+
+        return property(getter, setter)
+
+    eds = _get("eds")
+    occ = _get("occ")
+    split = _get("split")
+    reached = _get("reached")
+    fin = _get("fin")
+    del _get
+
+
+#: process-wide overlap accounting: seconds of host work that ran while
+#: a deferred result was still un-fetched (see ``DeferredStats``)
+_overlap_lock = threading.Lock()
+_overlap_total = 0.0
+
+
+def _note_overlap(seconds: float) -> None:
+    global _overlap_total
+    with _overlap_lock:
+        _overlap_total += seconds
+    try:  # metrics are optional; never let accounting break a dispatch
+        from waffle_con_tpu.obs.metrics import metrics_enabled, registry
+
+        if metrics_enabled():
+            registry().counter("waffle_host_overlap_seconds_total").inc(
+                seconds
+            )
+    except Exception:  # noqa: BLE001 - pure observability
+        pass
+
+
+def host_overlap_total() -> float:
+    """Cumulative ``host_overlap_s``: how long deferred run results
+    stayed un-fetched while the host did other work (bench evidence
+    reads the delta around a run)."""
+    with _overlap_lock:
+        return _overlap_total
+
+
+def resolve_stats(obj):
+    """Force every :class:`DeferredStats` reachable in a dispatch result
+    (returns ``obj`` unchanged otherwise).  The serve path calls this
+    before a result crosses a thread boundary — deferral is only safe
+    while the consumer is the dispatching thread."""
+    if isinstance(obj, DeferredStats):
+        obj.resolve()
+    elif isinstance(obj, (list, tuple)):
+        for x in obj:
+            resolve_stats(x)
+    return obj
+
+
+def deferred_sync_enabled() -> bool:
+    """Whether scorers may return :class:`DeferredStats`
+    (``WAFFLE_ASYNC_SYNC``, default on; ``0`` forces the old eager
+    fetch everywhere)."""
+    return os.environ.get("WAFFLE_ASYNC_SYNC", "1") != "0"
 
 
 #: counter names that each correspond to one blocking device dispatch;

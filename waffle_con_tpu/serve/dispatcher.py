@@ -50,6 +50,7 @@ from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import slo as obs_slo
 from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.obs.instrument import TIMED_OPS
+from waffle_con_tpu.ops.scorer import resolve_stats
 from waffle_con_tpu.serve.job import ServiceClosed
 
 
@@ -293,7 +294,13 @@ class BatchingDispatcher:
                     try:
                         if req.ticket is not None:
                             req.ticket.check_abort(req.op)
-                        req.result = req.fn()
+                        # coalesced execution crosses a thread boundary:
+                        # force any deferred-sync stats NOW, on the
+                        # dispatching thread, so the worker receives a
+                        # fully materialized result (async-seam
+                        # fall-through — deferral is only safe while
+                        # the consumer is the dispatching thread)
+                        req.result = resolve_stats(req.fn())
                     except BaseException as exc:  # delivered to the worker
                         req.exception = exc
                     finally:
