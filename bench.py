@@ -801,6 +801,16 @@ def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None,
         "serve_stats": stats,
         "runtime_events": _runtime_events(),
     }
+    # recompile + ragged-gang evidence (satellite of the paged band-state
+    # arena): compile_total counts distinct (kernel, geometry) jit keys
+    # seen by this process, ragged_mean_occupancy is run dispatches per
+    # arena kernel call (0.0 when nothing ganged / WAFFLE_RAGGED=0)
+    from waffle_con_tpu.ops.jax_scorer import compile_count
+
+    out["compile_total"] = compile_count()
+    out["ragged_mean_occupancy"] = round(
+        stats["dispatch"].get("ragged_mean_occupancy", 0.0), 4
+    )
     # rolling SLO snapshot (p50/p95/p99 + EWMA over dispatch latency and
     # job wall time) and any flight-recorder incidents the run produced
     from waffle_con_tpu.obs import flight as obs_flight
@@ -817,6 +827,120 @@ def bench_serve(num_jobs, num_reads, seq_len, error_rate, trace_out=None,
     slowest = (wall, tracer.chrome_events()) if tracer is not None else (wall, None)
     _obs_finish(out, tracer, trace_out, reports, slowest)
     return out
+
+
+def bench_serve_mix(num_jobs, error_rate=0.01):
+    """Heterogeneous serving benchmark: ``num_jobs`` single jobs with
+    heavy-tailed read counts and lengths (seeded Pareto draws, so every
+    job is a distinct shape bucket) run through :class:`ConsensusService`
+    twice — once with ragged dispatch disabled (``WAFFLE_RAGGED=0``, the
+    bucketed baseline, which on all-distinct shapes degrades to
+    occupancy-1 run clusters and per-shape recompiles) and once with the
+    paged band-state arena ganging run dispatches across jobs.
+
+    Reports jobs/s for both phases, the arena's mean gang occupancy vs
+    the baseline's mean run-cluster occupancy, per-phase recompile
+    deltas (``compile_count()``), and a parity bit over EVERY job
+    against serial references.  Each phase runs twice (warmup + timed)
+    so neither pays its compiles inside the timed window."""
+    import numpy as np
+
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.ops import ragged as ops_ragged
+    from waffle_con_tpu.ops.jax_scorer import compile_count
+    from waffle_con_tpu.serve import ConsensusService, JobRequest, ServeConfig
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    rng = np.random.default_rng(20260805)
+    shapes = []
+    for _ in range(num_jobs):
+        n_reads = int(min(20, 4 + rng.pareto(1.5) * 3))
+        seq_len = int(min(480, 120 + rng.pareto(1.5) * 80))
+        shapes.append((n_reads, seq_len))
+    jobs = []
+    for i, (n_reads, seq_len) in enumerate(shapes):
+        reads = generate_test(4, seq_len, n_reads, error_rate,
+                              seed=1000 + i)[1]
+        cfg = (
+            CdwfaConfigBuilder()
+            .min_count(max(2, n_reads // 4))
+            .backend("jax")
+            .initial_band(_band_seed(seq_len, error_rate))
+            .build()
+        )
+        jobs.append((reads, cfg))
+
+    serial = [
+        _make_engine("single", cfg, reads).consensus()
+        for reads, cfg in jobs
+    ]
+
+    def run_phase(ragged_on):
+        prev = os.environ.get("WAFFLE_RAGGED")
+        os.environ["WAFFLE_RAGGED"] = "1" if ragged_on else "0"
+        ops_ragged.reset_arena()
+        try:
+            c0 = compile_count()
+            results, wall, stats = None, 0.0, {}
+            for _attempt in range(2):  # warmup, then timed
+                svc = ConsensusService(
+                    ServeConfig(
+                        workers=min(num_jobs, 8),
+                        queue_limit=max(8, 2 * num_jobs),
+                        batch_window_s=0.005,
+                        max_batch=8,
+                    )
+                )
+                t0 = time.perf_counter()
+                handles = svc.submit_all([
+                    JobRequest(kind="single", reads=tuple(r), config=c)
+                    for r, c in jobs
+                ])
+                results = [h.result() for h in handles]
+                wall = time.perf_counter() - t0
+                stats = svc.stats()
+                svc.close()
+            return results, wall, stats, compile_count() - c0
+        finally:
+            if prev is None:
+                os.environ.pop("WAFFLE_RAGGED", None)
+            else:
+                os.environ["WAFFLE_RAGGED"] = prev
+
+    b_res, b_wall, b_stats, b_comp = run_phase(False)
+    r_res, r_wall, r_stats, r_comp = run_phase(True)
+
+    parity = all(r == s for r, s in zip(b_res, serial)) and all(
+        r == s for r, s in zip(r_res, serial)
+    )
+    ragged_occ = r_stats.get("ragged", {}).get("mean_occupancy", 0.0)
+    bucketed_occ = b_stats["dispatch"].get(
+        "run_cluster_mean_occupancy", 0.0
+    )
+    return {
+        "metric": f"serve_mix_{num_jobs}jobs_jobs_per_s",
+        "value": round(num_jobs / r_wall, 4),
+        "unit": "jobs/s",
+        "mode": "serve-mix",
+        "jobs": num_jobs,
+        "shapes": shapes,
+        "jobs_per_s_ragged": round(num_jobs / r_wall, 4),
+        "jobs_per_s_bucketed": round(num_jobs / b_wall, 4),
+        "speedup": round(b_wall / r_wall, 4),
+        "ragged_occupancy": round(ragged_occ, 4),
+        "bucketed_run_occupancy": round(bucketed_occ, 4),
+        "occupancy_ratio": round(ragged_occ / max(bucketed_occ, 1e-9), 4),
+        "compiles_bucketed": b_comp,
+        "compiles_ragged": r_comp,
+        "compile_total": compile_count(),
+        "parity": parity,
+        "ragged_stats": r_stats.get("ragged", {}),
+        "dispatch_ragged": {
+            k: v for k, v in r_stats["dispatch"].items()
+            if k.startswith("ragged") or k.startswith("run_cluster")
+        },
+        "runtime_events": _runtime_events(),
+    }
 
 
 def _child_cmd(mode_args, platform):
@@ -1102,6 +1226,15 @@ def main() -> None:
         "p50/p95 job latency",
     )
     parser.add_argument(
+        "--serve-mix", type=int, default=None, metavar="N",
+        dest="serve_mix",
+        help="heterogeneous serving mode: N jobs with heavy-tailed "
+        "read counts/lengths (every job a distinct shape), run both "
+        "bucketed (WAFFLE_RAGGED=0) and ragged; reports jobs/s, gang "
+        "occupancy vs the bucketed baseline, recompile deltas, and an "
+        "all-jobs parity bit",
+    )
+    parser.add_argument(
         "--serve-supervised", action="store_true",
         help="with --serve: run the served jobs under the fault-"
         "tolerant supervisor (warmup stays unsupervised), so "
@@ -1123,7 +1256,7 @@ def main() -> None:
     # never touches jax in the parent (children carry --platform)
     if args.platform == "cpu" and (
         args._run or args._gate or args.grid or args.dual or args.priority
-        or args.serve or args.microbench
+        or args.serve or args.serve_mix or args.microbench
     ):
         _force_cpu_backend()
 
@@ -1165,6 +1298,15 @@ def main() -> None:
             trace_out=args.trace_out,
             supervised=args.serve_supervised,
         )
+        out["device_platform"] = _current_platform()
+        print(json.dumps(out))
+        return
+
+    if args.serve_mix:
+        from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        out = bench_serve_mix(args.serve_mix)
         out["device_platform"] = _current_platform()
         print(json.dumps(out))
         return

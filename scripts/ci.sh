@@ -170,4 +170,42 @@ print(
 )
 PY
 
+echo "== serve-mix smoke (ragged cross-job batching) =="
+MIX_OUT="$(mktemp /tmp/waffle_ci_mix.XXXXXX.json)"
+trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT" "$MIX_OUT"' EXIT
+
+# heterogeneous job geometries: the ragged arena must gang jobs across
+# shape buckets (occupancy), keep results byte-identical to serial, and
+# compile a CONSTANT number of kernels regardless of job shapes (the
+# pool geometry + pow2 row-prefix ladder bound the keys, not the
+# number of distinct job shapes)
+WAFFLE_METRICS=1 BENCH_SMOKE=1 \
+  python bench.py --serve-mix 6 --platform cpu > "$MIX_OUT"
+
+python - "$MIX_OUT" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    evidence = json.loads(fh.read().strip().splitlines()[-1])
+assert evidence.get("mode") == "serve-mix", sorted(evidence)
+assert evidence["parity"] is True, "ragged/bucketed diverged from serial"
+occ = evidence["ragged_occupancy"]
+assert occ > 1.5, f"ragged occupancy {occ} <= 1.5"
+# constant-compile bound: the ragged phase may compile the gang kernel
+# (pow2 row-prefix ladder), slot-put stores, and the shared pool-floored
+# solo kernels -- a fixed envelope, independent of the job-shape count
+assert evidence["compiles_ragged"] <= 24, evidence["compiles_ragged"]
+ragged = evidence["ragged_stats"]
+assert ragged["groups"] >= 1, ragged
+assert ragged["pages_used"] == 0, ragged  # completion released all pages
+assert ragged["member_store_failures"] == 0, ragged
+print(
+    f"ci serve-mix smoke ok: occupancy={occ} "
+    f"(bucketed {evidence['bucketed_run_occupancy']}), "
+    f"compiles={evidence['compiles_ragged']}, "
+    f"{evidence['jobs_per_s_ragged']} jobs/s ragged"
+)
+PY
+
 echo "== ci.sh: all green =="

@@ -1,0 +1,273 @@
+"""Ragged cross-job batching over the paged band-state arena.
+
+Core claims under test: (1) mixed-geometry jax jobs served concurrently
+gang into shared ragged kernel calls and still return results
+byte-identical to serial execution; (2) the page table gives typed
+backpressure (:class:`ArenaExhausted`) on exhaustion and the serve path
+degrades to bucketed/solo dispatch instead of failing jobs; (3) pages
+recycle after release; (4) a supervisor backend demotion releases the
+demoted scorer's pages; (5) the ragged kernel itself is step/code/
+append/stats-identical to the solo ``run_extend`` path.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder
+from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.ops import ragged
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.runtime.supervisor import BackendSupervisor
+from waffle_con_tpu.serve import (
+    ArenaExhausted,
+    ConsensusService,
+    JobRequest,
+    ServeConfig,
+)
+from waffle_con_tpu.serve.service import _build_engine
+from waffle_con_tpu.utils.example_gen import generate_test
+
+pytestmark = pytest.mark.serve
+
+BIG = 10**9
+
+
+@pytest.fixture
+def arena_env(monkeypatch):
+    """Force ragged dispatch on and give the test a fresh arena (the
+    singleton re-reads the WAFFLE_RAGGED_* knobs on next use)."""
+    monkeypatch.setenv("WAFFLE_RAGGED", "1")
+    ragged.reset_arena()
+    yield
+    ragged.reset_arena()
+
+
+def _jax_cfg(**kw):
+    b = CdwfaConfigBuilder().backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _mixed_geometry_requests():
+    """Eight jax jobs across distinct (num_reads, length) geometries —
+    different shape buckets, so only the ragged path can batch them."""
+    shapes = [
+        (4, 90), (7, 140), (3, 60), (10, 200),
+        (5, 120), (6, 180), (4, 250), (8, 100),
+    ]
+    requests = []
+    for seed, (n, length) in enumerate(shapes):
+        _, reads = generate_test(n, length, 6, 0.02, seed=seed)
+        cfg = _jax_cfg(min_count=max(2, n // 4))
+        requests.append(
+            JobRequest(kind="single", reads=tuple(reads), config=cfg)
+        )
+    return requests
+
+
+# ----------------------------------------------- serve parity (tentpole)
+
+
+def test_mixed_geometry_serve_parity_with_gangs(arena_env):
+    requests = _mixed_geometry_requests()
+    expected = [_build_engine(r).consensus() for r in requests]
+
+    with ConsensusService(
+        ServeConfig(workers=8, batch_window_s=0.05, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        results = [h.result(timeout=300) for h in handles]
+        stats = svc.stats()
+
+    for req, got, want in zip(requests, results, expected):
+        assert got == want, "ragged-served job diverged from serial"
+    assert stats["jobs"]["failed"] == 0
+
+    arena = stats["ragged"]
+    # cross-bucket gangs actually formed, and job completion released
+    # every page back to the pool
+    assert arena["groups"] >= 1
+    assert arena["members"] >= 2
+    assert arena["admits"] == arena["releases"]
+    assert arena["pages_used"] == 0
+    assert arena["member_store_failures"] == 0
+
+
+# ----------------------------------------------- exhaustion backpressure
+
+
+def test_page_table_exhaustion_is_typed():
+    pt = ragged.PageTable(n_pages=2, page_rows=8)
+    rows = pt.alloc(1, 8)
+    assert rows.tolist() == list(range(8))
+    pt.alloc(2, 5)  # rounds up to one page
+    assert pt.free_pages == 0
+    with pytest.raises(ArenaExhausted):
+        pt.alloc(3, 1)
+    # release recycles; LIFO hands the freed page straight back
+    assert pt.release(2)
+    assert pt.free_pages == 1
+    assert pt.alloc(3, 3).tolist() == list(range(8, 16))
+    assert not pt.release(99)
+
+
+def test_admit_exhaustion_degrades_and_pages_recycle(arena_env, monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED_ROWS", "16")
+    monkeypatch.setenv("WAFFLE_RAGGED_PAGE", "8")
+    ragged.reset_arena()
+
+    _, reads = generate_test(8, 60, 6, 0.02, seed=11)
+    with ragged.serve_scope():
+        scorers = [JaxScorer(tuple(reads), CdwfaConfig()) for _ in range(3)]
+    arena = ragged.get_arena()
+    # two 8-read jobs fill the two pages; the third admit reports
+    # exhaustion as a graceful None (probe falls back to solo), with
+    # the typed counter bumped
+    assert arena.try_admit(scorers[0], job_id=1) is not None
+    assert arena.try_admit(scorers[1], job_id=2) is not None
+    assert arena.try_admit(scorers[2], job_id=3) is None
+    assert arena.stats()["exhausted"] == 1
+    # re-admission of a resident scorer is idempotent, not a new alloc
+    assert arena.try_admit(scorers[0], job_id=1) is not None
+    assert arena.stats()["admits"] == 2
+
+    # release one member: its pages recycle to the waiting third job
+    arena.release_scorer(scorers[0])
+    rows = arena.try_admit(scorers[2], job_id=3)
+    assert rows is not None and len(rows) == 8
+    arena.release_job(2)
+    arena.release_scorer(scorers[2])
+    st = arena.stats()
+    assert st["pages_used"] == 0
+    assert st["pages_free"] == st["pages_total"]
+
+
+def test_tiny_pool_serve_still_byte_identical(arena_env, monkeypatch):
+    """With a pool too small for most jobs, serving must complete with
+    full parity anyway — exhausted probes just run bucketed/solo."""
+    monkeypatch.setenv("WAFFLE_RAGGED_ROWS", "8")
+    monkeypatch.setenv("WAFFLE_RAGGED_PAGE", "8")
+    ragged.reset_arena()
+    requests = _mixed_geometry_requests()[:4]
+    expected = [_build_engine(r).consensus() for r in requests]
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=0.02, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        results = [h.result(timeout=300) for h in handles]
+    assert results == expected
+
+
+# ----------------------------------------------- supervisor demotion
+
+
+@pytest.mark.faultinject
+def test_supervisor_demotion_releases_pages(arena_env, faults):
+    cfg = _jax_cfg(
+        min_count=1, backend_chain=("python",), dispatch_retries=1,
+        breaker_threshold=2, retry_backoff_s=0.0,
+    )
+    reads = (b"ACGTACGTACGT", b"ACGTACGTACGT", b"ACCTACGTACGT")
+    with ragged.serve_scope():
+        sup = BackendSupervisor(reads, cfg)
+    inner = sup._scorer
+    arena = ragged.get_arena()
+    assert arena.try_admit(inner, job_id=42) is not None
+    assert arena.stats()["pages_used"] > 0
+
+    # every jax dispatch now faults: first root() fails, its retry
+    # fails, the breaker trips -> demotion to python mid-residency
+    faults.add("timeout", backend="jax", count=None)
+    sup.root(np.ones(len(reads), dtype=bool))
+    demotions = events.get_events("backend_demoted")
+    assert [(d["from_backend"], d["to_backend"]) for d in demotions] == [
+        ("jax", "python")
+    ]
+    st = arena.stats()
+    assert st["pages_used"] == 0
+    assert st["releases"] == 1
+
+
+# ----------------------------------------------- direct kernel parity
+
+
+def _mutated_reads(n, lo, hi, seed):
+    r = np.random.default_rng(seed)
+    base = r.integers(0, 4, size=int(r.integers(lo, hi))).astype(np.uint8)
+    reads = []
+    for _ in range(n):
+        b = base.copy()
+        m = r.random(len(b)) < 0.03
+        b[m] = r.integers(0, 4, int(m.sum())).astype(np.uint8)
+        reads.append(bytes(b))
+    return reads
+
+
+def test_ragged_kernel_matches_solo_run_extend(arena_env):
+    """Mixed-geometry gangs through the ragged kernel step-for-step:
+    steps, stop code, appended bytes, and every vote-stats array equal
+    the solo ``run_extend`` path across multiple rounds."""
+    jobs = [
+        _mutated_reads(5, 80, 120, 1),
+        _mutated_reads(9, 150, 200, 2),
+        _mutated_reads(3, 40, 60, 3),
+    ]
+    with ragged.serve_scope():
+        solos = [JaxScorer(r, CdwfaConfig()) for r in jobs]
+        rags = [JaxScorer(r, CdwfaConfig()) for r in jobs]
+
+    hs_s = [s.root(np.ones(len(j), bool)) for s, j in zip(solos, jobs)]
+    hs_r = [s.root(np.ones(len(j), bool)) for s, j in zip(rags, jobs)]
+    cons_s = [b""] * 3
+    cons_r = [b""] * 3
+    arena = ragged.get_arena()
+
+    for rnd in range(4):
+        solo_out = [
+            s.run_extend(h, c, BIG, BIG, 0, 2, False, 8,
+                         allow_records=False)
+            for s, h, c in zip(solos, hs_s, cons_s)
+        ]
+        args_list = [
+            (h, c, BIG, BIG, 0, 2, False, 8)
+            for h, c in zip(hs_r, cons_r)
+        ]
+        specs = []
+        for s, a in zip(rags, args_list):
+            spec = ragged.probe((s.ragged_run_probe, a, {}))
+            assert spec is not None, "eligible member refused"
+            specs.append(spec)
+        keys = ragged.run_group(specs)
+        assert len(keys) == 3
+        rag_out = [s.run_extend(*a) for s, a in zip(rags, args_list)]
+        assert all(
+            s.counters.get("run_ragged_injected", 0) == rnd + 1
+            for s in rags
+        )
+        for g, (so, ro) in enumerate(zip(solo_out, rag_out)):
+            s_steps, s_code, s_app, s_stats, s_rec = so
+            r_steps, r_code, r_app, r_stats, r_rec = ro
+            ctx = f"round {rnd} job {g}"
+            assert (s_steps, s_code, s_app) == (r_steps, r_code, r_app), ctx
+            assert s_rec == [] and r_rec == []
+            np.testing.assert_array_equal(s_stats.eds, r_stats.eds, ctx)
+            np.testing.assert_array_equal(s_stats.occ, r_stats.occ, ctx)
+            np.testing.assert_array_equal(s_stats.split, r_stats.split, ctx)
+            np.testing.assert_array_equal(
+                s_stats.reached, r_stats.reached, ctx
+            )
+            if s_stats.fin is None:
+                assert r_stats.fin is None, ctx
+            else:
+                np.testing.assert_array_equal(s_stats.fin, r_stats.fin, ctx)
+            cons_s[g] += s_app
+            cons_r[g] += r_app
+
+    st = arena.stats()
+    assert st["groups"] == 4
+    assert st["mean_occupancy"] == 3.0
+    for s in rags:
+        s.ragged_release()
+    assert arena.stats()["pages_used"] == 0

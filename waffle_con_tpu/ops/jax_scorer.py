@@ -137,6 +137,51 @@ def _next_pow2(n: int, minimum: int = 1) -> int:
     return max(minimum, 1 << max(0, (n - 1).bit_length()))
 
 
+#: first-seen static geometry keys of the heavy jitted entry points.
+#: Every new (kernel, static-shape-key) pair is a fresh XLA compilation
+#: (modulo the on-disk compile cache, which still costs a trace +
+#: deserialize), so the set size is the process's recompile count — the
+#: number the serve bench and CI assert stays constant under ragged
+#: dispatch no matter how many distinct job geometries arrive.
+_COMPILE_SEEN: set = set()
+
+
+def _note_compile(kernel: str, key: tuple) -> None:
+    """Record a (kernel, static-shape-key) pair the first time it is
+    dispatched; backs ``waffle_compile_total`` and ``compile_count``."""
+    k = (kernel,) + tuple(key)
+    if k in _COMPILE_SEEN:
+        return
+    _COMPILE_SEEN.add(k)
+    from waffle_con_tpu.obs import metrics as obs_metrics
+
+    if obs_metrics.metrics_enabled():
+        obs_metrics.registry().counter(
+            "waffle_compile_total", kernel=kernel
+        ).inc()
+
+
+def compile_count() -> int:
+    """Distinct (kernel, geometry) compilations seen this process."""
+    return len(_COMPILE_SEEN)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _j_slot_put(state, h, D, e, rmin, er, cons, clen):
+    """Store a full band-state row set back into slot ``h`` (the ragged
+    arena's scatter-back after a gang step); donation keeps it a cheap
+    in-place update of the state dict's big buffers."""
+    return dict(
+        state,
+        D=state["D"].at[h].set(D),
+        e=state["e"].at[h].set(e),
+        rmin=state["rmin"].at[h].set(rmin),
+        er=state["er"].at[h].set(er),
+        cons=state["cons"].at[h].set(cons),
+        clen=state["clen"].at[h].set(clen),
+    )
+
+
 # ======================================================================
 # column kernels.  A "row" is one branch: D [R, W] plus per-read scalars.
 # All dense symbol ids; `wc` is the wildcard dense id or -2; `et` is
@@ -2576,6 +2621,15 @@ class JaxScorer(WavefrontScorer):
         super().__init__(reads, config)
         n = len(self.reads)
         self._R = max(_next_pow2(max(n, 1)), self.MIN_R)
+        # inside a served job the geometry floors rise to the ragged
+        # arena's pool shapes, so every served job shares ONE compiled
+        # kernel set (solo and ragged alike) and band-width equality —
+        # the arena's byte-identity precondition — holds by default
+        from waffle_con_tpu.ops import ragged as _ragged
+
+        hint = _ragged.geometry_hint()
+        if hint is not None:
+            self._R = max(self._R, hint.rows)
         ms = config.mesh_shards or 1
         if self._R % ms:
             self._R = ms * ((self._R + ms - 1) // ms)
@@ -2584,6 +2638,8 @@ class JaxScorer(WavefrontScorer):
         #: real (unpadded) max read length; sizes the pallas staging
         self._max_rlen = max_len
         self._L = max(_next_pow2(max(max_len, 1)), self.MIN_L)
+        if hint is not None and max_len <= hint.length:
+            self._L = max(self._L, hint.length)
         self._A = max(_next_pow2(max(self.num_symbols, 1)), self.MIN_A)
 
         # int16 symbol storage: dense ids are < 257 and the -1 sentinel
@@ -2613,8 +2669,12 @@ class JaxScorer(WavefrontScorer):
             self._E = _next_pow2(int(config.initial_band), self.INITIAL_E)
         else:
             self._E = self.INITIAL_E
+        if hint is not None:
+            self._E = max(self._E, hint.band)
         self._B = self.INITIAL_SLOTS
         self._C = max(_next_pow2(max_len + 64), self.MIN_C)
+        if hint is not None:
+            self._C = max(self._C, hint.cons)
         #: fused-pallas run-loop mode ("tpu" | "interpret" | "off"),
         #: resolved once; the transposed reads staging is built lazily
         #: on the first pallas run and dropped on band growth
@@ -2833,6 +2893,9 @@ class JaxScorer(WavefrontScorer):
         syms += [syms[0]] * (npad - n)
         packed = np.asarray([slots, syms], dtype=np.int32)
         while True:
+            _note_compile("j_push_batch", (
+                self._B, self._R, self._W, self._C, self._A, npad,
+            ))
             state, stats, overflow = _j_push_batch(
                 self._state, self._reads, self._rlen, packed,
                 self._wc, self._et, self._A,
@@ -3099,6 +3162,28 @@ class JaxScorer(WavefrontScorer):
         off0 = int(offs[0])
         return bool((offs == off0).all()), off0
 
+    def ragged_run_probe(self, h: int):
+        """Duck-typed hop for the serve layer's ragged dispatch: return
+        ``(self, handle)`` when this scorer can in principle join a
+        cross-job ragged gang for ``h`` (the arena still checks geometry
+        eligibility against the live call args).  Proxies without this
+        attribute — python backend, subset scorer — are simply never
+        ragged-batched."""
+        from waffle_con_tpu.ops import ragged as _ragged
+
+        if not _ragged.enabled() or h not in self._slot_of:
+            return None
+        return (self, h)
+
+    def ragged_release(self) -> None:
+        """Release this scorer's paged-arena residency (no-op when not
+        resident); the supervisor calls it before swapping backends so a
+        demoted scorer's pages free immediately and its pending
+        injections drop."""
+        from waffle_con_tpu.ops import ragged as _ragged
+
+        _ragged.release_scorer(self)
+
     def run_extend(
         self,
         h: int,
@@ -3123,6 +3208,40 @@ class JaxScorer(WavefrontScorer):
         already-nominated unique child as step 0.  See ``_j_run`` for
         the stop-code contract; on overflow the band is grown so the
         caller can simply continue stepping."""
+        from waffle_con_tpu.ops import ragged as _ragged
+
+        inj = _ragged.take_injected(self, h)
+        if inj is not None:
+            # this exact call was precomputed by a ragged gang step (see
+            # ops.ragged.BandArena.run_group): the state is already
+            # advanced in our slot — return the deposited result through
+            # the normal contract so supervision/validation/tracing all
+            # see an ordinary run_extend
+            if inj.len0 != len(consensus):  # pragma: no cover - guard
+                raise RuntimeError(
+                    "ragged injection desynchronized: precomputed at "
+                    f"consensus length {inj.len0}, called at "
+                    f"{len(consensus)}"
+                )
+            self._invalidate_root_stats()
+            steps, code = inj.steps, inj.code
+            self.counters["run_calls"] += 1
+            self.counters["run_steps"] += steps
+            self.counters["run_iters"] += inj.iters
+            self.counters["run_spec_cols"] += inj.iters  # ragged is K=1
+            key = f"run_stop_{code}"
+            self.counters[key] = self.counters.get(key, 0) + 1
+            self.counters["run_ragged_injected"] = (
+                self.counters.get("run_ragged_injected", 0) + 1
+            )
+            appended = b""
+            if steps:
+                appended = (
+                    self.symtab[inj.ids[:steps]].astype(np.uint8).tobytes()
+                )
+            if code == 5:
+                self._grow_e()  # band now mismatches the pool: solo next
+            return steps, code, appended, self._stats_np(inj.stats), []
         self._invalidate_root_stats()
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
@@ -3153,6 +3272,9 @@ class JaxScorer(WavefrontScorer):
         if use_pallas:
             from waffle_con_tpu.ops.pallas_run import _j_run_pallas
 
+            _note_compile(
+                "j_run_pallas", (self._B, self._R, self._W, MS, i16)
+            )
             out = self._pallas_guarded(
                 1, MS, _j_run_pallas,
                 self._state, self._reads_T(), self._rlen, params,
@@ -3167,6 +3289,10 @@ class JaxScorer(WavefrontScorer):
                 iters, cols = steps, 1  # fused kernel: one col per iter
         if not use_pallas:
             cols = _run_cols()
+            _note_compile("j_run", (
+                self._B, self._R, self._W, self._C, self._L, self._A,
+                uniform, self.num_symbols, self._xla_i16(), cols,
+            ))
             (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
              rec_count, rec_steps, rec_fins, iters) = _j_run(
                 self._state, self._reads, self._reads_pad, self._rlen,
@@ -3316,6 +3442,9 @@ class JaxScorer(WavefrontScorer):
                 max(len(consensus1), len(consensus2)), max_steps
             )
             params[10] = capped
+            _note_compile(
+                "j_run_dual_pallas", (self._B, self._R, self._W, MS, i16)
+            )
             out = self._pallas_guarded(
                 2, MS, _j_run_dual_pallas,
                 self._state, self._reads_T(), self._rlen, params,
@@ -3333,6 +3462,10 @@ class JaxScorer(WavefrontScorer):
                 iters, cols = steps, 1  # fused kernel: one col per iter
         if not use_pallas:
             cols = _run_cols()
+            _note_compile("j_run_dual", (
+                self._B, self._R, self._W, self._C, self._L, self._A,
+                uni1 and uni2, self.num_symbols, self._xla_i16(), cols,
+            ))
             (state, steps, code, stats1, stats2, act1, act2, consa,
              consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
              rec_a2, iters) = _j_run_dual(
@@ -3580,6 +3713,10 @@ class JaxScorer(WavefrontScorer):
         # compiling large unrolled arena graphs before (see the
         # tournament comment in _j_arena)
         cols = min(_run_cols(), 4)
+        _note_compile("j_arena", (
+            self._B, self._R, self._W, self._C, self._A, K, uniform,
+            self.num_symbols, cols,
+        ))
         (state, hist, nsteps, code, stop_node, steps, stats, act, cons,
          clen, alive, cre_count, cre_parent, cre_kind, cre_sym1,
          cre_sym2, cre_len, stop_diag, iters) = (

@@ -1,0 +1,1019 @@
+"""Ragged cross-job device batching over a paged band-state arena.
+
+The serve layer's :class:`~waffle_con_tpu.serve.dispatcher.BatchingDispatcher`
+only coalesces jobs that share an exact compiled shape bucket, so realistic
+heterogeneous traffic — mixed read counts, read lengths, band widths —
+fragments into occupancy-1 dispatches and pays a per-shape recompile tax.
+This module is the Ragged-Paged-Attention answer (arXiv:2604.15464; the
+same packing gpuPairHMM applies to DP alignment batches): ONE kernel
+instance steps *all* active jobs' reads in a single call, with per-read
+band state living in fixed-size pages of one preallocated device pool
+behind a host-managed page table.
+
+Shape of the thing:
+
+* :class:`PageTable` — host-side alloc/free lists over ``ROWS`` pool rows
+  quantized to ``PAGE``-row pages, per-job page runs.  Exhaustion raises
+  the typed :class:`ArenaExhausted` (the dispatcher then falls back to
+  the bucketed path — backpressure, never corruption).
+* :class:`BandArena` — the device pool: persistent staged reads
+  (``[ROWS, L] int16`` + lengths) plus the one compiled ragged kernel.
+  Pool geometry (``ROWS x PAGE x W x C``) is fixed at construction, so
+  exactly ONE kernel compilation serves every job shape.
+* ``probe()`` — resolves a parked ``run_extend`` dispatch down the proxy
+  stack (``CoalescingScorer`` → supervisor → ``JaxScorer``) via the
+  duck-typed ``ragged_run_probe`` hop, checks geometry eligibility, and
+  lazily admits the job's reads into the pool.
+* ``run_group()`` — gathers each member's band state into the pool
+  layout (per-row ``(job, read)`` descriptors replace the padded
+  ``[R, ...]`` batch), runs the ragged kernel once, scatters the
+  results back into each scorer's own slot, and deposits a consume-once
+  *injected result* per member; the member's ordinary ``run_extend``
+  dispatch then returns it instantly, so supervision, fault injection,
+  validation, and tracing all compose unchanged.
+
+Byte-identity with the serial path:
+
+* the kernel is the single-column (K=1) ``_j_run`` body with every
+  per-branch reduction replaced by a segment-reduce keyed by job — the
+  speculative-K contract already guarantees K=1 ≡ any K;
+* members are only admitted when their band width equals the pool's
+  (the serve layer floors job geometry to the pool's, see
+  ``geometry_hint``), so state moves by straight row copy — no
+  re-centering, no value changes;
+* record absorption is force-disabled (``allow_records=0`` semantics:
+  reached states stop with code 2, which the engine already handles),
+  trading extra dispatches for exactness;
+* f32 vote sums segment-reduce in a different order than the solo
+  stack-sum, but every decision is either taken on exact dyadic values
+  or guarded by the ``VOTE_EPS`` margin (near-ties go dirty → host f64
+  arbitration), so decisions are identical.
+
+Disabled with ``WAFFLE_RAGGED=0`` (bucketed path untouched).  Pool
+sizing: ``WAFFLE_RAGGED_ROWS`` / ``WAFFLE_RAGGED_PAGE`` /
+``WAFFLE_RAGGED_E`` / ``WAFFLE_RAGGED_L`` / ``WAFFLE_RAGGED_C`` /
+``WAFFLE_RAGGED_GANG``.
+
+This module imports jax lazily (inside the arena) so the serve layer can
+import it unconditionally, python-backend-only stacks included.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from waffle_con_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+#: params-row layout of the per-member ``jp [G+1, 10] int32`` array
+_JP_COLS = 10
+
+_RUN_ARGS = (
+    "h", "consensus", "me_budget", "other_cost", "other_len",
+    "min_count", "l2", "max_steps", "first_sym", "allow_records",
+)
+
+
+class ArenaExhausted(RuntimeError):
+    """Typed backpressure: the page table cannot hold another job's
+    reads.  Callers fall back to the bucketed dispatch path — this must
+    never surface as a corrupted result."""
+
+
+def enabled() -> bool:
+    """Ragged dispatch master switch (``WAFFLE_RAGGED``, default on)."""
+    return os.environ.get("WAFFLE_RAGGED", "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+# ======================================================================
+# serve-scope geometry hint.  Constant-compile-count story: every serve
+# job built inside the scope floors its scorer geometry up to the pool's
+# (R/L/E/C), so ALL jobs share one compiled kernel set for their own
+# solo dispatches too — compile count is bounded by the pool geometry
+# (plus the log-bounded branch-slot growth ladder), NOT by the number of
+# distinct job shapes.  Naturally-larger jobs keep their natural shapes
+# (still correct, just bucketed/solo when the band width mismatches).
+
+
+@dataclass(frozen=True)
+class GeometryHint:
+    band: int    # floor for the scorer's band half-width E (pool E)
+    rows: int    # floor for the read-slot axis R
+    length: int  # floor for the reads axis L
+    cons: int    # floor for the consensus axis C
+
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def serve_scope():
+    """Marks the current thread as building/running a served job: scorer
+    constructors consult :func:`geometry_hint` while it is active."""
+    prev = getattr(_TLS, "serving", 0)
+    _TLS.serving = prev + 1
+    try:
+        yield
+    finally:
+        _TLS.serving = prev
+
+
+def geometry_hint() -> Optional[GeometryHint]:
+    """The serve-scope geometry floor, or None outside a served job (or
+    with ragged dispatch disabled — the bucketed baseline keeps its
+    natural per-shape geometry, recompiles and all).
+
+    Only the band half-width and the consensus axis are floored.  W
+    equality is the arena's hard gang-eligibility requirement, and E is
+    the one axis pow2 growth would otherwise scatter across jobs (it
+    doubles adaptively at runtime).  C is floored because eligibility
+    demands ``len(consensus) + max_steps + 2 < C`` *at probe time* —
+    the solo wrapper grows C lazily mid-run, so a natural C of 512
+    against step budgets in the hundreds would veto nearly every gang;
+    the cons axis is O(C) scatter work per step, not [R, W] row work,
+    so the floor is cheap.  R/L stay natural — the gather/scatter
+    handles any per-member R/L, and flooring them was measured to cost
+    far more on every SOLO dispatch of small jobs (4x row work at
+    R 16->64) than it saved in compile-key sharing: pow2 quantization
+    inside the pool envelope already bounds the distinct kernel keys by
+    a pool-determined constant, not by the number of distinct job
+    shapes."""
+    if not getattr(_TLS, "serving", 0) or not enabled():
+        return None
+    cfg = ArenaConfig.from_env()
+    return GeometryHint(band=cfg.band_e, rows=0, length=0, cons=cfg.cons_len)
+
+
+# ======================================================================
+# configuration + page table
+
+
+def _env_int(name: str, default: int, lo: int, hi: int) -> int:
+    try:
+        return max(lo, min(hi, int(os.environ.get(name, default))))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class ArenaConfig:
+    """Pool geometry, snapshotted from the environment at arena build."""
+
+    rows: int = 256       # total pool rows (reads across all jobs)
+    page_rows: int = 8    # rows per page (residency quantum)
+    band_e: int = 32      # pool band half-width; W = 2E + 2
+    read_len: int = 512   # staged read columns
+    cons_len: int = 2048  # per-member consensus capacity
+    gang: int = 8         # max members per ragged kernel call
+    alphabet: int = 8     # dense vote width (matches JaxScorer.MIN_A)
+
+    @staticmethod
+    def from_env() -> "ArenaConfig":
+        return ArenaConfig(
+            rows=_env_int("WAFFLE_RAGGED_ROWS", 256, 16, 1 << 16),
+            page_rows=_env_int("WAFFLE_RAGGED_PAGE", 8, 1, 256),
+            band_e=_env_int("WAFFLE_RAGGED_E", 32, 8, 512),
+            read_len=_env_int("WAFFLE_RAGGED_L", 512, 64, 1 << 15),
+            cons_len=_env_int("WAFFLE_RAGGED_C", 2048, 256, 1 << 16),
+            gang=_env_int("WAFFLE_RAGGED_GANG", 8, 2, 64),
+        )
+
+
+class PageTable:
+    """Host-side fixed-page allocator over the arena's row pool.
+
+    Pages are the residency quantum: a job's ``num_reads`` rows round up
+    to whole pages, so the pool upload scatter only ever sees
+    page-multiple row counts (bounded distinct shapes regardless of job
+    geometry).  Free pages recycle LIFO."""
+
+    def __init__(self, n_pages: int, page_rows: int) -> None:
+        if n_pages < 1 or page_rows < 1:
+            raise ValueError("page table needs >= 1 page of >= 1 row")
+        self.n_pages = n_pages
+        self.page_rows = page_rows
+        self._free: List[int] = list(range(n_pages - 1, -1, -1))
+        self._held: Dict[int, List[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def alloc(self, key: int, rows_needed: int) -> np.ndarray:
+        """Allocate the page run covering ``rows_needed`` rows under
+        ``key``; returns the (page-quantized) pool row indices.  Raises
+        :class:`ArenaExhausted` when the pool cannot hold them."""
+        if rows_needed < 1:
+            raise ValueError("rows_needed must be >= 1")
+        pages = -(-rows_needed // self.page_rows)
+        if pages > len(self._free):
+            raise ArenaExhausted(
+                f"band-state pool exhausted: need {pages} pages "
+                f"({rows_needed} rows), {len(self._free)} free of "
+                f"{self.n_pages}"
+            )
+        got = [self._free.pop() for _ in range(pages)]
+        self._held[key] = got
+        return np.concatenate([
+            np.arange(p * self.page_rows, (p + 1) * self.page_rows)
+            for p in sorted(got)
+        ]).astype(np.int64)
+
+    def release(self, key: int) -> bool:
+        pages = self._held.pop(key, None)
+        if pages is None:
+            return False
+        self._free.extend(pages)
+        return True
+
+
+# ======================================================================
+# dispatch-time records
+
+
+@dataclass
+class RunSpec:
+    """One probed-and-admitted gang member: the resolved ``JaxScorer``
+    endpoint plus the normalized ``run_extend`` call args."""
+
+    scorer: object
+    h: int
+    vals: Dict
+    ticket: object = None
+    job_id: Optional[int] = None
+
+
+@dataclass
+class _Injected:
+    """A consume-once precomputed ``run_extend`` result deposited by
+    :meth:`BandArena.run_group`; the member's own dispatch returns it."""
+
+    len0: int
+    steps: int
+    code: int
+    ids: np.ndarray          # appended dense symbol ids (length >= steps)
+    stats: tuple             # 6-tuple feeding JaxScorer._stats_np
+    iters: int
+
+
+@dataclass
+class _Residency:
+    scorer: object           # strong ref: keyed by id() while resident
+    rows: np.ndarray
+    job_id: Optional[int] = None
+    keys: List[Tuple[int, int]] = field(default_factory=list)
+
+
+def _normalize_run_args(args, kwargs) -> Optional[Dict]:
+    """Positional/keyword ``run_extend`` call -> named dict (None when
+    the shape is unrecognized — then the call just runs solo)."""
+    if len(args) > len(_RUN_ARGS):
+        return None
+    vals: Dict = {"first_sym": -1, "allow_records": True}
+    vals.update(zip(_RUN_ARGS, args))
+    for k, v in kwargs.items():
+        if k not in _RUN_ARGS:
+            return None
+        vals[k] = v
+    if any(k not in vals for k in _RUN_ARGS[:8]):
+        return None
+    return vals
+
+
+# ======================================================================
+# the arena
+
+
+class BandArena:
+    """Device-resident paged band-state pool + the one ragged kernel.
+
+    All host bookkeeping (page table, residency, injections, counters)
+    is guarded by one lock; device work happens on the dispatcher thread
+    (``run_group``) with ``release_job`` the only cross-thread caller.
+    """
+
+    def __init__(self, cfg: ArenaConfig) -> None:
+        self.cfg = cfg
+        self.rows = cfg.rows
+        self.page_rows = cfg.page_rows
+        self.E = cfg.band_e
+        self.W = 2 * cfg.band_e + 2
+        self.L = cfg.read_len
+        self.C = cfg.cons_len
+        self.gang = cfg.gang
+        self.A = cfg.alphabet
+        self.pages = PageTable(cfg.rows // cfg.page_rows, cfg.page_rows)
+        self._lock = threading.RLock()
+        self._resident: Dict[int, _Residency] = {}
+        self._injected: Dict[Tuple[int, int], _Injected] = {}
+        self._counters = {
+            "groups": 0, "members": 0, "occupancy_max": 0,
+            "admits": 0, "releases": 0, "exhausted": 0,
+            "injected_consumed": 0, "injected_dropped": 0,
+            "member_store_failures": 0,
+        }
+        self._reads = None   # [ROWS, L] int16 device, staged lazily
+        self._rlen = None    # [ROWS] int32 device
+        self._kernel = None
+
+    # -- device pool ---------------------------------------------------
+
+    def _ensure_pool(self) -> None:
+        if self._reads is not None:
+            return
+        import jax
+
+        self._reads = jax.device_put(
+            np.full((self.rows, self.L), -1, dtype=np.int16)
+        )
+        self._rlen = jax.device_put(np.zeros(self.rows, dtype=np.int32))
+
+    def _publish_pages(self) -> None:
+        if not obs_metrics.metrics_enabled():
+            return
+        reg = obs_metrics.registry()
+        reg.gauge("waffle_ragged_pool_pages_used").set(self.pages.used_pages)
+        reg.gauge("waffle_ragged_pool_pages_free").set(self.pages.free_pages)
+
+    # -- eligibility + residency ---------------------------------------
+
+    def eligible(self, scorer, vals: Dict) -> bool:
+        """Geometry gate for one probed member.  Band-width equality is
+        the byte-identity keystone: state then moves by straight row
+        copy.  The consensus-capacity check mirrors the solo wrapper's
+        grow condition so an injected run never needed a grow."""
+        try:
+            n = scorer.num_reads
+            if n < 1 or n > self.rows:
+                return False
+            if getattr(scorer, "_shardings", None) is not None:
+                return False
+            if scorer._W != self.W:
+                return False
+            if scorer.num_symbols > self.A:
+                return False
+            if scorer._max_rlen > self.L:
+                return False
+            need = len(vals["consensus"]) + int(vals["max_steps"]) + 2
+            if need >= min(scorer._C, self.C):
+                return False
+        except (AttributeError, TypeError):
+            return False
+        return True
+
+    def try_admit(self, scorer, job_id: Optional[int]) -> Optional[np.ndarray]:
+        """Lazy admission on first probe: allocate this scorer's page
+        run and stage its reads into the pool.  Returns the pool rows,
+        or None on exhaustion (graceful bucketed fallback)."""
+        with self._lock:
+            key = id(scorer)
+            res = self._resident.get(key)
+            if res is not None:
+                if res.job_id is None:
+                    res.job_id = job_id
+                return res.rows
+            try:
+                rows = self.pages.alloc(key, scorer.num_reads)
+            except ArenaExhausted:
+                self._counters["exhausted"] += 1
+                if obs_metrics.metrics_enabled():
+                    obs_metrics.registry().counter(
+                        "waffle_ragged_exhausted_total"
+                    ).inc()
+                return None
+            self._ensure_pool()
+            block = np.full((len(rows), self.L), -1, dtype=np.int16)
+            rlen = np.zeros(len(rows), dtype=np.int32)
+            sym_id = scorer.sym_id
+            for i, r in enumerate(scorer.reads):
+                block[i, : len(r)] = [sym_id[b] for b in r]
+                rlen[i] = len(r)
+            self._reads = self._reads.at[rows].set(block)
+            self._rlen = self._rlen.at[rows].set(rlen)
+            self._resident[key] = _Residency(scorer, rows, job_id)
+            self._counters["admits"] += 1
+            self._publish_pages()
+            return rows
+
+    def _release_key(self, key: int) -> None:
+        res = self._resident.pop(key, None)
+        if res is None:
+            return
+        self.pages.release(key)
+        self._counters["releases"] += 1
+        # pending injections for the departing scorer are stale by
+        # definition (a rebuilt backend replays from the ledger)
+        for k in [k for k in self._injected if k[0] == key]:
+            self._injected.pop(k, None)
+            self._counters["injected_dropped"] += 1
+        self._publish_pages()
+
+    def release_scorer(self, scorer) -> None:
+        with self._lock:
+            self._release_key(id(scorer))
+
+    def release_job(self, job_id) -> None:
+        if job_id is None:
+            return
+        with self._lock:
+            for key in [
+                k for k, r in self._resident.items() if r.job_id == job_id
+            ]:
+                self._release_key(key)
+
+    # -- injections ----------------------------------------------------
+
+    def take_injected(self, scorer, h: int) -> Optional[_Injected]:
+        with self._lock:
+            inj = self._injected.pop((id(scorer), int(h)), None)
+            if inj is not None:
+                self._counters["injected_consumed"] += 1
+            return inj
+
+    def discard_injected(self, keys) -> None:
+        """Drop injections deposited for a batch that were never
+        consumed (e.g. the member's dispatch raised before reaching the
+        scorer) — a stale injection must never survive into a later
+        call."""
+        with self._lock:
+            for k in keys:
+                if self._injected.pop(k, None) is not None:
+                    self._counters["injected_dropped"] += 1
+
+    # -- the ragged kernel ---------------------------------------------
+
+    def _build_kernel(self):
+        """The one compiled geometry: ``_j_run``'s K=1 body with every
+        per-branch fold replaced by a segment-reduce keyed by the
+        per-row job id (``seg``).  Static shapes are pool-only
+        (``ROWS x W x C x (G+1) x A``), so exactly one compilation
+        serves every member mix."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from waffle_con_tpu.ops.jax_scorer import (
+            INF, VOTE_EPS, _cummin_rows,
+        )
+
+        @partial(jax.jit, static_argnames=("A",))
+        def _j_run_ragged(reads, rlen, D0, e0, rmin0, er0, off, act, seg,
+                          cons0, clen0, jp, A):
+            ROWS, W = D0.shape
+            L = reads.shape[1]
+            G1, C = cons0.shape
+            E = jnp.int32((W - 2) // 2)
+            EPS = VOTE_EPS
+
+            in_group = jp[:, 0].astype(bool)
+            me_budget = jp[:, 1]
+            other_cost = jp[:, 2]
+            other_len = jp[:, 3]
+            min_count_f = jp[:, 4].astype(jnp.float32)
+            l2 = jp[:, 5].astype(bool)
+            max_steps = jp[:, 6]
+            first_sym = jp[:, 7]
+            wc = jp[:, 8]
+            et = jp[:, 9].astype(bool)
+
+            l2_r = l2[seg]
+            wc_r = wc[seg]
+            et_r = et[seg]
+            t = jnp.arange(W, dtype=jnp.int32)[None, :]
+            gi = jnp.arange(G1, dtype=jnp.int32)
+
+            def seg_sum(x):
+                return jnp.zeros(
+                    (G1,) + x.shape[1:], x.dtype
+                ).at[seg].add(x)
+
+            def seg_any(x):
+                return jnp.zeros((G1,), jnp.int32).at[seg].max(
+                    x.astype(jnp.int32)
+                ) > 0
+
+            def seg_max0(x):  # folds over non-negative int32 values
+                return jnp.zeros((G1,), x.dtype).at[seg].max(x)
+
+            def col_step(D, e, rmin, er, jnew_r, sym_r):
+                # row-wise _col_step_w: identical formulas with the
+                # per-branch scalars (sym/wc/et/jnew) per-row vectors
+                i_new = jnew_r[:, None] - off[:, None] - E + t
+                bchar = jnp.take_along_axis(
+                    reads, jnp.clip(i_new - 1, 0, L - 1), axis=1
+                )
+                sub = (
+                    (bchar != sym_r[:, None]) & (bchar != wc_r[:, None])
+                ).astype(jnp.int32)
+                diag = D + sub
+                dele = jnp.concatenate(
+                    [D[:, 1:], jnp.full_like(D[:, :1], INF)], axis=1
+                ) + 1
+                base = jnp.minimum(diag, dele)
+                invalid = (i_new < 0) | (i_new > rlen[:, None])
+                base = jnp.where(invalid, jnp.int32(INF), base)
+                chain = _cummin_rows(base - t)
+                Dn = jnp.minimum(
+                    jnp.minimum(base, chain + t), jnp.int32(INF)
+                )
+                colmin = Dn.min(axis=1)
+                rend = jnp.where(
+                    i_new == rlen[:, None], Dn, jnp.int32(INF)
+                ).min(axis=1)
+                rmin_n = jnp.minimum(rmin, rend)
+                e_unc = jnp.maximum(e, colmin)
+                e_cap = jnp.where(
+                    er < INF, e,
+                    jnp.maximum(
+                        e, jnp.minimum(colmin, jnp.maximum(e, rmin_n))
+                    ),
+                )
+                e_n = jnp.where(et_r, e_cap, e_unc)
+                er_n = jnp.where(
+                    er < INF, er,
+                    jnp.where(rmin_n <= e_n, jnp.maximum(e, rmin_n), INF),
+                )
+                D = jnp.where(act[:, None], Dn, D)
+                e = jnp.where(act, e_n, e)
+                rmin = jnp.where(act, rmin_n, rmin)
+                er = jnp.where(act, er_n, er)
+                return D, e, rmin, er
+
+            def stats_rows(D, e, rmin, er, clen):
+                # row-wise _stats_core at the full pool vote width (the
+                # columns past a member's real alphabet are structurally
+                # zero — inert for every decision below)
+                clen_r = clen[seg]
+                i = clen_r[:, None] - off[:, None] - E + t
+                vchar = jnp.take_along_axis(
+                    reads, jnp.clip(i, 0, L - 1), axis=1
+                )
+                tip = (
+                    act[:, None] & (D <= e[:, None])
+                    & (i >= 0) & (i < rlen[:, None])
+                )
+                onehot = (
+                    vchar[:, :, None] == jnp.arange(A)[None, None, :]
+                ) & tip[:, :, None]
+                occ = onehot.sum(axis=1, dtype=jnp.int32)
+                split = occ.sum(axis=1)
+                reached = act & (er < INF) & (e == er)
+                eds = jnp.where(act, e, 0)
+                return eds, occ, split, reached
+
+            def substep(carry):
+                D, e, rmin, er, cons, clen, steps, code, iters = carry
+                live = in_group & (code == 0)
+                eds, occ, split, reached = stats_rows(D, e, rmin, er, clen)
+                fin_j = jnp.where(
+                    act, jnp.minimum(jnp.maximum(e, rmin), INF), 0
+                )
+                costs = jnp.where(l2_r, eds * eds, eds)
+                total = seg_sum(costs)
+                nonexact = jnp.where(
+                    split > 0, (split & (split - 1)) != 0, False
+                )
+                eds_max = seg_max0(eds)
+                fin_max = seg_max0(fin_j)
+                all_exact = ~seg_any(nonexact)
+                cost_overflow = l2 & (eds_max > 2048)
+                # reached fold mirrors _j_run's conservative semantics:
+                # inactive lanes count as done under early termination
+                reached_here = jnp.where(
+                    et, ~seg_any(act & ~reached), seg_any(reached)
+                )
+                frac = jnp.where(
+                    split[:, None] > 0,
+                    occ.astype(jnp.float32)
+                    / jnp.maximum(split, 1)[:, None].astype(jnp.float32),
+                    0.0,
+                )
+                counts = seg_sum(frac)                      # [G1, A]
+                has_votes = seg_sum((occ > 0).astype(jnp.float32)) > 0
+                n_cands = has_votes.sum(axis=1)
+                wc_col = jnp.maximum(wc, 0)
+                drop_wc = (wc >= 0) & (n_cands > 1)
+                a_idx = jnp.arange(A, dtype=jnp.int32)[None, :]
+                wc_mask = drop_wc[:, None] & (a_idx == wc_col[:, None])
+                has_votes = has_votes & ~wc_mask
+                counts = jnp.where(wc_mask, 0.0, counts)
+                maxc = jnp.where(has_votes, counts, -1.0).max(axis=1)
+                thr = jnp.minimum(min_count_f, maxc)
+                passing = has_votes & (counts >= thr[:, None])
+                npass = passing.sum(axis=1)
+                near_tie = (jnp.abs(maxc - min_count_f) < EPS) | (
+                    (has_votes & (jnp.abs(counts - thr[:, None]) < EPS))
+                    .any(axis=1)
+                )
+                ambiguous = ~all_exact & near_tie
+                dirty = (
+                    ambiguous | (npass != 1) | (n_cands == 0)
+                    | cost_overflow
+                )
+                # allow_records is force-disabled on the ragged path, so
+                # _j_run's rec_blocked is identically True: a reached
+                # state always stops with code 2
+                wins_pop = (total < other_cost) | (
+                    (total == other_cost) & (clen > other_len)
+                )
+                code_new = jnp.where(
+                    (total > me_budget) | ~wins_pop, 3,
+                    jnp.where(
+                        reached_here, 2,
+                        jnp.where(
+                            dirty, 1,
+                            jnp.where(steps >= max_steps, 4, 0),
+                        ),
+                    ),
+                )
+                sym = jnp.argmax(
+                    jnp.where(passing, counts, -1.0), axis=1
+                ).astype(jnp.int32)
+                clen2 = clen + 1
+                D2, e2, rmin2, er2 = col_step(
+                    D, e, rmin, er, clen2[seg], sym[seg]
+                )
+                ovf = seg_any(act & (e2 >= E))
+                commit = live & (code_new == 0) & ~ovf
+                code = jnp.where(
+                    ~live, code,
+                    jnp.where(
+                        code_new != 0, code_new, jnp.where(ovf, 5, 0)
+                    ),
+                )
+                cpos = jnp.clip(clen, 0, C - 1)
+                cons = cons.at[gi, cpos].set(
+                    jnp.where(commit, sym, cons[gi, cpos])
+                )
+                cm = commit[seg]
+                D = jnp.where(cm[:, None], D2, D)
+                e = jnp.where(cm, e2, e)
+                rmin = jnp.where(cm, rmin2, rmin)
+                er = jnp.where(cm, er2, er)
+                clen = clen + commit.astype(jnp.int32)
+                steps = steps + commit.astype(jnp.int32)
+                iters = iters + live.astype(jnp.int32)
+                return (D, e, rmin, er, cons, clen, steps, code, iters)
+
+            # forced first push per member (host-nominated child): only
+            # band overflow refuses it — same contract as _j_run
+            force = in_group & (first_sym >= 0)
+            Df, ef, rminf, erf = col_step(
+                D0, e0, rmin0, er0, (clen0 + 1)[seg], first_sym[seg]
+            )
+            fovf = seg_any(act & (ef >= E))
+            fcommit = force & ~fovf
+            code_init = jnp.where(force & fovf, 5, 0).astype(jnp.int32)
+            cpos0 = jnp.clip(clen0, 0, C - 1)
+            cons1 = cons0.at[gi, cpos0].set(
+                jnp.where(fcommit, first_sym, cons0[gi, cpos0])
+            )
+            fm = fcommit[seg]
+            D1 = jnp.where(fm[:, None], Df, D0)
+            e1 = jnp.where(fm, ef, e0)
+            rmin1 = jnp.where(fm, rminf, rmin0)
+            er1 = jnp.where(fm, erf, er0)
+            clen1 = clen0 + fcommit.astype(jnp.int32)
+            steps0 = fcommit.astype(jnp.int32)
+
+            init = (
+                D1, e1, rmin1, er1, cons1, clen1, steps0, code_init,
+                jnp.zeros((G1,), jnp.int32),
+            )
+            (D, e, rmin, er, cons, clen, steps, code,
+             iters) = lax.while_loop(
+                lambda c: jnp.any(in_group & (c[7] == 0)), substep, init
+            )
+            eds, occ, split, reached = stats_rows(D, e, rmin, er, clen)
+            fin = jnp.maximum(e, rmin)
+            fin_ovf = seg_any(act & (fin >= E))
+            fin_r = jnp.where(act, jnp.minimum(fin, INF), 0)
+            return (D, e, rmin, er, cons, clen, steps, code, iters,
+                    eds, occ, split, reached, fin_r, fin_ovf)
+
+        return _j_run_ragged
+
+    # -- gang execution ------------------------------------------------
+
+    def run_group(self, specs: List[RunSpec]) -> List[Tuple[int, int]]:
+        """Step every gang member in ONE ragged kernel call.
+
+        Per member: gather its slot's band state into the pool layout,
+        run, scatter the advanced state back into its own slot, THEN
+        deposit the injected result — deposit strictly after a
+        successful store, so a member whose store fails simply runs solo
+        from its unmutated state (crash consistency).  Returns the
+        deposited injection keys (the dispatcher discards leftovers
+        after the batch).  Never raises: any failure degrades the
+        affected members to the solo path."""
+        try:
+            return self._run_group(specs)
+        except Exception:  # noqa: BLE001 - ragged must never fail a job
+            logger.warning(
+                "ragged group of %d failed; members fall back to solo",
+                len(specs), exc_info=True,
+            )
+            return []
+
+    def _run_group(self, specs: List[RunSpec]) -> List[Tuple[int, int]]:
+        import jax
+
+        from waffle_con_tpu.ops import jax_scorer as js
+
+        G = self.gang
+        G1 = G + 1
+        members = []
+        with self._lock:
+            for spec in specs[:G]:
+                res = self._resident.get(id(spec.scorer))
+                slot = spec.scorer._slot_of.get(spec.h)
+                if res is None or slot is None:
+                    continue
+                members.append((spec, res.rows, slot))
+        if len(members) < 2:
+            return []
+
+        # LIFO page allocation keeps runs packed low, so the dispatch
+        # only steps the pow2 row-prefix covering every member's run —
+        # compile keys gain a log2(rows)-bounded ladder, the kernel
+        # skips the pool's idle tail entirely
+        hi = 1 + max(int(rows[-1]) for _, rows, _ in members)
+        P = 1
+        while P < hi:
+            P *= 2
+        P = min(P, self.rows)
+
+        # one device_get per member: its slot's full band-state rows
+        loaded = []
+        for spec, rows, slot in members:
+            st = spec.scorer._state
+            loaded.append(jax.device_get((
+                st["D"][slot], st["e"][slot], st["rmin"][slot],
+                st["er"][slot], st["cons"][slot], st["clen"][slot],
+            )))
+
+        D = np.full((P, self.W), int(js.INF), np.int32)
+        e = np.zeros(P, np.int32)
+        rmin = np.full(P, int(js.INF), np.int32)
+        er = np.full(P, int(js.INF), np.int32)
+        off = np.zeros(P, np.int32)
+        act = np.zeros(P, bool)
+        seg = np.full(P, G, np.int32)
+        cons = np.zeros((G1, self.C), np.int32)
+        clen = np.zeros(G1, np.int32)
+        jp = np.zeros((G1, _JP_COLS), np.int32)
+
+        live = []
+        for (spec, rows, slot), ld in zip(members, loaded):
+            scorer, vals = spec.scorer, spec.vals
+            if int(ld[5]) != len(vals["consensus"]):
+                continue  # engine/ledger desync: solo path decides
+            ns = min(len(rows), scorer._R)
+            rs = rows[:ns]
+            D[rs] = ld[0][:ns]
+            e[rs] = ld[1][:ns]
+            rmin[rs] = ld[2][:ns]
+            er[rs] = ld[3][:ns]
+            off[rs] = scorer._off_host[slot][:ns]
+            act[rs] = scorer._act_host[slot][:ns]
+            g = len(live)
+            seg[rows] = g
+            cc = min(scorer._C, self.C)
+            cons[g, :cc] = ld[4][:cc]
+            clen[g] = int(ld[5])
+            cfg = scorer.config
+            wc_int = (
+                scorer.sym_id.get(cfg.wildcard, -2)
+                if cfg.wildcard is not None else -2
+            )
+            jp[g] = (
+                1,
+                min(int(vals["me_budget"]), 2**31 - 1),
+                min(int(vals["other_cost"]), 2**31 - 1),
+                int(vals["other_len"]),
+                int(vals["min_count"]),
+                int(bool(vals["l2"])),
+                int(vals["max_steps"]),
+                int(vals["first_sym"]),
+                int(wc_int),
+                int(bool(cfg.allow_early_termination)),
+            )
+            live.append(((spec, rows, slot), ld, ns))
+        if len(live) < 2:
+            return []
+
+        self._ensure_pool()
+        if self._kernel is None:
+            self._kernel = self._build_kernel()
+        js._note_compile(
+            "j_run_ragged", (P, self.W, self.L, self.C, G1, self.A)
+        )
+        out = jax.device_get(self._kernel(
+            self._reads[:P], self._rlen[:P], D, e, rmin, er, off, act,
+            seg, cons, clen, jp, A=self.A,
+        ))
+        (oD, oe, ormin, oer, ocons, oclen, osteps, ocode, oiters,
+         oeds, oocc, osplit, oreached, ofin, ofovf) = out
+
+        keys: List[Tuple[int, int]] = []
+        n_members = len(live)
+        for g, ((spec, rows, slot), ld, ns) in enumerate(live):
+            scorer = spec.scorer
+            rs = rows[:ns]
+            try:
+                # store back: kernel rows overwrite the member's first
+                # ns state rows, the tail keeps its loaded values
+                Dn = np.array(ld[0])
+                Dn[:ns] = oD[rs]
+                en = np.array(ld[1]); en[:ns] = oe[rs]
+                rn = np.array(ld[2]); rn[:ns] = ormin[rs]
+                ern = np.array(ld[3]); ern[:ns] = oer[rs]
+                cn = np.array(ld[4])
+                cc = min(scorer._C, self.C)
+                cn[:cc] = ocons[g, :cc]
+                js._note_compile("j_slot_put", tuple(
+                    scorer._state[k].shape for k in
+                    ("D", "e", "rmin", "er", "cons", "clen")
+                ))
+                scorer._state = js._j_slot_put(
+                    scorer._state, np.int32(slot), Dn, en, rn, ern, cn,
+                    np.int32(oclen[g]),
+                )
+            except Exception:  # noqa: BLE001 - degrade this member only
+                with self._lock:
+                    self._counters["member_store_failures"] += 1
+                logger.warning(
+                    "ragged store-back failed for member %d; solo "
+                    "fallback", g, exc_info=True,
+                )
+                state_lost = any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree_util.tree_leaves(scorer._state)
+                )
+                if state_lost:
+                    raise  # unrecoverable: supervisor machinery handles
+                continue
+            len0 = len(spec.vals["consensus"])
+            steps = int(osteps[g])
+            inj = _Injected(
+                len0=len0,
+                steps=steps,
+                code=int(ocode[g]),
+                ids=np.asarray(ocons[g, len0:len0 + max(steps, 0)]),
+                stats=(
+                    oeds[rs], oocc[rs], osplit[rs], oreached[rs],
+                    ofin[rs], not bool(ofovf[g]),
+                ),
+                iters=int(oiters[g]),
+            )
+            key = (id(scorer), int(spec.h))
+            with self._lock:
+                self._injected[key] = inj
+            keys.append(key)
+
+        with self._lock:
+            self._counters["groups"] += 1
+            self._counters["members"] += n_members
+            self._counters["occupancy_max"] = max(
+                self._counters["occupancy_max"], n_members
+            )
+        if obs_metrics.metrics_enabled():
+            obs_metrics.registry().histogram(
+                "waffle_ragged_occupancy",
+                buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+            ).observe(n_members)
+        return keys
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            c = dict(self._counters)
+        groups = c["groups"]
+        return {
+            "active": True,
+            "enabled": enabled(),
+            "rows": self.rows,
+            "page_rows": self.page_rows,
+            "pages_total": self.pages.n_pages,
+            "pages_used": self.pages.used_pages,
+            "pages_free": self.pages.free_pages,
+            "band_e": self.E,
+            "gang": self.gang,
+            "mean_occupancy": (c["members"] / groups) if groups else 0.0,
+            **c,
+        }
+
+
+# ======================================================================
+# process-wide arena + module-level API (what the serve layer calls)
+
+_ARENA: Optional[BandArena] = None
+_ARENA_LOCK = threading.Lock()
+
+
+def get_arena() -> BandArena:
+    global _ARENA
+    with _ARENA_LOCK:
+        if _ARENA is None:
+            _ARENA = BandArena(ArenaConfig.from_env())
+        return _ARENA
+
+
+def peek_arena() -> Optional[BandArena]:
+    return _ARENA
+
+
+def reset_arena() -> None:
+    """Drop the process arena (tests re-read the env knobs; any device
+    pool memory is released with it)."""
+    global _ARENA
+    with _ARENA_LOCK:
+        _ARENA = None
+
+
+def gang_width() -> int:
+    return get_arena().gang
+
+
+def probe(payload, ticket=None) -> Optional[RunSpec]:
+    """Resolve one parked ``run_extend`` dispatch into a gang member.
+
+    ``payload`` is ``(probe_attr, args, kwargs)`` captured by the
+    coalescing proxy; ``probe_attr`` hops the proxy/supervisor stack to
+    the live ``JaxScorer`` endpoint (or None when the current backend
+    cannot take part).  Returns None — bucketed/solo fallback — on any
+    ineligibility, including pool exhaustion."""
+    if not enabled():
+        return None
+    probe_fn, args, kwargs = payload
+    vals = _normalize_run_args(args, kwargs)
+    if vals is None:
+        return None
+    try:
+        endpoint = probe_fn(vals["h"])
+    except Exception:  # noqa: BLE001 - a dead handle just runs solo
+        return None
+    if endpoint is None:
+        return None
+    scorer, bh = endpoint
+    arena = get_arena()
+    if not arena.eligible(scorer, vals):
+        return None
+    job_id = getattr(ticket, "job_id", None)
+    if arena.try_admit(scorer, job_id) is None:
+        return None
+    return RunSpec(
+        scorer=scorer, h=int(bh), vals=vals, ticket=ticket, job_id=job_id
+    )
+
+
+def run_group(specs: List[RunSpec]) -> List[Tuple[int, int]]:
+    return get_arena().run_group(specs)
+
+
+def take_injected(scorer, h: int):
+    a = _ARENA
+    if a is None:
+        return None
+    return a.take_injected(scorer, h)
+
+
+def discard_injected(keys) -> None:
+    a = _ARENA
+    if a is not None:
+        a.discard_injected(keys)
+
+
+def release_scorer(scorer) -> None:
+    a = _ARENA
+    if a is not None:
+        a.release_scorer(scorer)
+
+
+def release_job(job_id) -> None:
+    a = _ARENA
+    if a is not None:
+        a.release_job(job_id)
+
+
+def arena_stats() -> Dict:
+    a = _ARENA
+    if a is None:
+        return {"active": False, "enabled": enabled()}
+    return a.stats()

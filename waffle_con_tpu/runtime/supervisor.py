@@ -226,6 +226,7 @@ class BackendSupervisor(WavefrontScorer):
                 self._pos = next_pos
                 continue
             old = self.backend
+            self._release_ragged()
             self._pos = next_pos
             self._scorer = scorer
             self.fastpath_gen += 1
@@ -252,6 +253,37 @@ class BackendSupervisor(WavefrontScorer):
                 old, target, len(self._ledger), cause,
             )
             return
+
+    def _release_ragged(self) -> None:
+        """A backend swap (demotion or re-promotion) rebuilds the live
+        search on a fresh backend, so the outgoing scorer's paged-arena
+        residency — if it has any — must be released NOW: its pages
+        would otherwise leak until job end and any pending ragged
+        injections would go stale against the rebuilt state."""
+        rel = getattr(self._scorer, "ragged_release", None)
+        if rel is None:
+            return
+        try:
+            rel()
+        except Exception:  # noqa: BLE001 - release must never block a swap
+            logger.warning(
+                "ragged-arena release failed during backend swap",
+                exc_info=True,
+            )
+
+    def ragged_run_probe(self, h: int):
+        """Ragged-dispatch hop through the supervisor: translate the
+        engine handle to the current backend's handle and delegate.
+        Returns None whenever the live backend cannot take part — the
+        dispatch then simply runs solo through the supervised path."""
+        inner = getattr(self._scorer, "ragged_run_probe", None)
+        if inner is None:
+            return None
+        try:
+            bh = self._ledger[h].backend_h
+        except KeyError:
+            return None
+        return inner(bh)
 
     def _note_success(self) -> None:
         self._consecutive_failures = 0
@@ -288,6 +320,7 @@ class BackendSupervisor(WavefrontScorer):
             self._probe_interval *= 2
             return
         old = self.backend
+        self._release_ragged()
         self._pos = target_pos
         self._scorer = scorer
         self.fastpath_gen += 1

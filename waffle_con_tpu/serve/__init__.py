@@ -19,14 +19,24 @@ of the single-search engine stack:
   and the runtime's ``BackendSupervisor``) that routes dispatches into
   the shared dispatcher.
 
+Ragged cross-job batching: with ``WAFFLE_RAGGED`` on (the default), the
+dispatcher additionally gangs eligible ``run_extend`` dispatches from
+*different* shape buckets into single kernel calls over the paged
+band-state arena (:mod:`waffle_con_tpu.ops.ragged`); pool exhaustion
+raises the typed :class:`~waffle_con_tpu.ops.ragged.ArenaExhausted`
+internally and degrades to the bucketed path.
+
 Observability: ``waffle_serve_queue_depth``/``waffle_serve_active_jobs``
 gauges, ``waffle_serve_jobs_total{outcome}`` /
 ``waffle_serve_admission_rejections_total`` /
 ``waffle_serve_direct_dispatches_total`` counters, and the
 ``waffle_serve_batch_occupancy`` / ``waffle_serve_job_latency_seconds``
-histograms (all gated on ``WAFFLE_METRICS``).
+histograms (all gated on ``WAFFLE_METRICS``); the arena adds
+``waffle_compile_total`` / ``waffle_ragged_pool_pages_{used,free}`` /
+``waffle_ragged_occupancy``.
 """
 
+from waffle_con_tpu.ops.ragged import ArenaExhausted
 from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
 from waffle_con_tpu.serve.dispatcher import (
     BatchingDispatcher,
@@ -47,6 +57,7 @@ from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
 
 __all__ = [
     "AdmissionQueue",
+    "ArenaExhausted",
     "BatchingDispatcher",
     "CoalescingScorer",
     "ConsensusService",

@@ -41,6 +41,7 @@ untouched, so engines cannot tell they are being served.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -50,8 +51,13 @@ from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import slo as obs_slo
 from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.obs.instrument import TIMED_OPS
+# ops.ragged imports nothing heavy at module scope (jax loads lazily
+# inside the arena), so this is safe for python-backend-only services
+from waffle_con_tpu.ops import ragged as ops_ragged
 from waffle_con_tpu.ops.scorer import resolve_stats
 from waffle_con_tpu.serve.job import ServiceClosed
+
+logger = logging.getLogger(__name__)
 
 
 def _pow2_ceil(n: int) -> int:
@@ -67,23 +73,41 @@ def bucket_key(scorer) -> tuple:
     config = getattr(scorer, "config", None)
     backend = getattr(config, "backend", "?")
     max_len = max((len(r) for r in reads), default=0)
+    # the speculative block width K is a static kernel argument read per
+    # dispatch (WAFFLE_RUN_COLS), so two jobs at different K run
+    # different compiled programs even at identical shapes — it must be
+    # part of the bucket or the "same compiled kernels" contract above
+    # silently breaks
+    k_cols = 0
+    if "jax" in str(backend):
+        try:
+            from waffle_con_tpu.ops.jax_scorer import _run_cols
+
+            k_cols = _run_cols()
+        except Exception:  # pragma: no cover - jax unavailable
+            k_cols = -1
     return (
         backend,
         _pow2_ceil(len(reads)),
         _pow2_ceil(max_len),
         int(getattr(scorer, "num_symbols", 0) or 0),
+        k_cols,
     )
 
 
 class _DispatchRequest:
-    __slots__ = ("ticket", "bucket", "op", "fn", "result", "exception",
-                 "done", "ctx", "enqueued_at")
+    __slots__ = ("ticket", "bucket", "op", "fn", "ragged", "result",
+                 "exception", "done", "ctx", "enqueued_at")
 
-    def __init__(self, ticket, bucket, op, fn) -> None:
+    def __init__(self, ticket, bucket, op, fn, ragged=None) -> None:
         self.ticket = ticket
         self.bucket = bucket
         self.op = op
         self.fn = fn
+        # optional ragged-dispatch payload (probe_fn, args, kwargs): the
+        # dispatcher may gang this run_extend with other jobs' through
+        # the paged band-state arena (see ops.ragged)
+        self.ragged = ragged
         self.result = None
         self.exception: Optional[BaseException] = None
         self.done = threading.Event()
@@ -131,6 +155,14 @@ class BatchingDispatcher:
             "direct_dispatches": 0,   # fell through (job alone / closed)
             "occupancy_sum": 0,
             "occupancy_max": 0,
+            # ragged gang accounting (tentpole) plus the bucketed
+            # baseline's run-dispatch clustering, so the two occupancy
+            # numbers compare apples to apples in bench evidence
+            "ragged_groups": 0,       # ragged kernel calls (>= 2 members)
+            "ragged_members": 0,      # run dispatches ganged into them
+            "ragged_occupancy_max": 0,
+            "run_clusters": 0,        # executed groups containing runs
+            "run_cluster_requests": 0,
         }
 
     # -- lifecycle -----------------------------------------------------
@@ -174,10 +206,13 @@ class BatchingDispatcher:
 
     # -- the dispatch path ---------------------------------------------
 
-    def dispatch(self, ticket, bucket: tuple, op: str, fn):
+    def dispatch(self, ticket, bucket: tuple, op: str, fn, ragged=None):
         """Run one blocking scorer dispatch, coalescing with concurrent
         jobs when possible.  ``ticket.check_abort(op)`` gates both entry
         and execution so cancellations/deadlines bite at this boundary.
+        ``ragged`` optionally carries the probe payload letting the
+        dispatcher gang this call across jobs (direct fall-through
+        ignores it — a lone job has nobody to gang with).
         """
         if ticket is not None:
             ticket.check_abort(op)
@@ -193,7 +228,7 @@ class BatchingDispatcher:
             if direct:
                 self._stats["direct_dispatches"] += 1
             else:
-                req = _DispatchRequest(ticket, bucket, op, fn)
+                req = _DispatchRequest(ticket, bucket, op, fn, ragged)
                 # flow start before the dispatcher can see the request,
                 # inside the worker's open search span, so the "s" event
                 # temporally precedes the dispatcher-side "f"
@@ -256,6 +291,67 @@ class BatchingDispatcher:
             self._execute(batch)
 
     def _execute(self, batch: List[_DispatchRequest]) -> None:
+        # ragged pass FIRST: gang eligible run_extend dispatches from
+        # *different* buckets into single arena kernel calls.  Each
+        # ganged member's result is deposited as a consume-once
+        # injection that its ordinary fn() below returns instantly, so
+        # execution order, tracing, supervision and error delivery are
+        # untouched; anything the pass cannot take simply runs solo.
+        injected_keys: List[tuple] = []
+        if len(batch) > 1 and ops_ragged.enabled():
+            injected_keys = self._ragged_pass(batch)
+        try:
+            self._execute_groups(batch)
+        finally:
+            # a member whose dispatch raised before reaching the scorer
+            # (abort/deadline) must not leave a stale injection behind
+            if injected_keys:
+                ops_ragged.discard_injected(injected_keys)
+
+    def _ragged_pass(self, batch: List[_DispatchRequest]) -> List[tuple]:
+        specs = []
+        seen_scorers = set()
+        for req in batch:
+            if req.ragged is None:
+                continue
+            try:
+                spec = ops_ragged.probe(req.ragged, req.ticket)
+            except Exception:  # noqa: BLE001 - probe failure = solo
+                logger.debug("ragged probe failed", exc_info=True)
+                continue
+            if spec is None:
+                continue
+            # one scorer may not appear twice in a gang (its pool rows
+            # would collide); the duplicate runs solo this round
+            sid = id(spec.scorer)
+            if sid in seen_scorers:
+                continue
+            seen_scorers.add(sid)
+            specs.append(spec)
+        if len(specs) < 2:
+            return []
+        keys: List[tuple] = []
+        gang = ops_ragged.gang_width()
+        for i in range(0, len(specs), gang):
+            chunk = specs[i:i + gang]
+            if len(chunk) < 2:
+                break  # a trailing singleton just runs solo
+            with obs_trace.span(
+                "serve:ragged", "serve", members=len(chunk)
+            ):
+                got = ops_ragged.run_group(chunk)
+            if not got:
+                continue
+            keys.extend(got)
+            with self._cond:
+                self._stats["ragged_groups"] += 1
+                self._stats["ragged_members"] += len(got)
+                self._stats["ragged_occupancy_max"] = max(
+                    self._stats["ragged_occupancy_max"], len(got)
+                )
+        return keys
+
+    def _execute_groups(self, batch: List[_DispatchRequest]) -> None:
         # group by shape bucket, preserving arrival order within and
         # across groups (first-seen bucket runs first)
         groups: Dict[tuple, List[_DispatchRequest]] = {}
@@ -264,6 +360,7 @@ class BatchingDispatcher:
         metrics_on = obs_metrics.metrics_enabled()
         for bucket, reqs in groups.items():
             occupancy = len(reqs)
+            run_reqs = sum(1 for r in reqs if r.op == "run")
             with self._cond:
                 if occupancy > 1:
                     self._stats["coalesced_batches"] += 1
@@ -273,6 +370,9 @@ class BatchingDispatcher:
                 self._stats["occupancy_max"] = max(
                     self._stats["occupancy_max"], occupancy
                 )
+                if run_reqs:
+                    self._stats["run_clusters"] += 1
+                    self._stats["run_cluster_requests"] += run_reqs
             if metrics_on:
                 obs_metrics.registry().histogram(
                     "waffle_serve_batch_occupancy",
@@ -334,6 +434,14 @@ class BatchingDispatcher:
         s["mean_batch_occupancy"] = (
             s["occupancy_sum"] / batches if batches else 0.0
         )
+        s["ragged_mean_occupancy"] = (
+            s["ragged_members"] / s["ragged_groups"]
+            if s["ragged_groups"] else 0.0
+        )
+        s["run_cluster_mean_occupancy"] = (
+            s["run_cluster_requests"] / s["run_clusters"]
+            if s["run_clusters"] else 0.0
+        )
         return s
 
 
@@ -377,10 +485,24 @@ class CoalescingScorer:
         dispatcher = self.__dict__["_dispatcher"]
         ticket = self.__dict__["_ticket"]
         bucket = self.__dict__["_bucket"]
+        # run_extend dispatches carry the ragged probe hop when the
+        # wrapped stack exposes one (JaxScorer / BackendSupervisor do;
+        # python backends and subset scorers don't) — resolution down to
+        # the live endpoint happens on the dispatcher thread, so a
+        # mid-flight backend demotion is seen, not raced
+        probe_attr = (
+            getattr(base, "ragged_run_probe", None)
+            if name == "run_extend" else None
+        )
 
         def routed(*args, **kwargs):
+            payload = (
+                (probe_attr, args, kwargs)
+                if probe_attr is not None else None
+            )
             return dispatcher.dispatch(
-                ticket, bucket, op, lambda: attr(*args, **kwargs)
+                ticket, bucket, op, lambda: attr(*args, **kwargs),
+                ragged=payload,
             )
 
         routed.__name__ = name

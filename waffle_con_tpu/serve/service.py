@@ -35,6 +35,7 @@ from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import slo as obs_slo
 from waffle_con_tpu.obs import trace as obs_trace
+from waffle_con_tpu.ops import ragged as ops_ragged
 from waffle_con_tpu.runtime import events
 from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
 from waffle_con_tpu.serve.dispatcher import BatchingDispatcher, CoalescingScorer
@@ -265,8 +266,12 @@ class ConsensusService:
                 "serve:job", "serve",
                 kind=handle.request.kind, job_id=handle.job_id,
             ):
-                engine = _build_engine(handle.request)
-                result = engine.consensus()
+                # serve scope: scorers built for this job floor their
+                # geometry to the ragged arena's pool shapes, making
+                # them gang-eligible (see ops.ragged.geometry_hint)
+                with ops_ragged.serve_scope():
+                    engine = _build_engine(handle.request)
+                    result = engine.consensus()
         except BaseException as exc:
             self._finalize(handle, exc)
         else:
@@ -277,6 +282,12 @@ class ConsensusService:
             self._account(handle, "done")
         finally:
             set_scorer_decorator(previous)
+            # page-table residency ends with the job: whatever scorers
+            # it admitted into the band-state arena free their pages now
+            try:
+                ops_ragged.release_job(handle.job_id)
+            except Exception:  # pragma: no cover - never block teardown
+                pass
             self._dispatcher.job_finished()
             obs_trace.set_current_context(prev_ctx)
 
@@ -370,4 +381,5 @@ class ConsensusService:
             "jobs": counts,
             "queue_depth": self._queue.depth(),
             "dispatch": self._dispatcher.stats(),
+            "ragged": ops_ragged.arena_stats(),
         }
