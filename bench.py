@@ -286,6 +286,47 @@ def _obs_finish(out, tracer, trace_out, reports, slowest):
         out["trace_out"] = trace_out
 
 
+def _emit(out, perfdb_kind=None):
+    """Stamp and print one evidence line; optionally persist it.
+
+    Every line bench.py prints goes through here: it carries the
+    evidence schema major (``obs.perfdb.EVIDENCE_SCHEMA``), a
+    ``phases`` dispatch breakdown when ``--profile`` is on, and — for
+    the perf-gated modes — one appended perfdb record so the run joins
+    the rolling CI baseline.  The append is best-effort: a read-only
+    checkout must never fail the bench."""
+    from waffle_con_tpu.obs import perfdb
+    from waffle_con_tpu.obs import phases as obs_phases
+
+    if obs_phases.profiling_enabled():
+        snap = obs_phases.snapshot()
+        if snap:
+            out["phases"] = snap
+    perfdb.stamp_evidence(out)
+    print(json.dumps(out), flush=True)
+    if perfdb_kind is None:
+        return
+    try:
+        rec = perfdb.make_record(
+            perfdb_kind,
+            out.get("metric", perfdb_kind),
+            float(out.get("value") or 0.0),
+            str(out.get("unit", "")),
+            platform=out.get("device_platform", "unknown"),
+            parity=out.get("parity"),
+        )
+        breakdown = out.get("breakdown")
+        if isinstance(breakdown, dict) and "run_cols" in breakdown:
+            rec["run_cols"] = breakdown["run_cols"]
+        if "phases" in out:
+            rec["phases"] = out["phases"]
+        path = perfdb.append_record(rec)
+        print(f"perfdb: appended {perfdb_kind} record to {path}",
+              file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 - history is best-effort
+        print(f"perfdb append failed: {exc!r}", file=sys.stderr)
+
+
 def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
                  trace_out=None):
     from waffle_con_tpu import CdwfaConfigBuilder
@@ -371,6 +412,7 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
         "value_median": round(tpu_time, 4),
         "n_iters": len(times),
         "unit": "s",
+        "mode": "north-star",
         "vs_baseline": round(cpu_time / tpu_time, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(
@@ -509,6 +551,7 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
         "metric": f"microbench_run_extend_{num_reads}x{seq_len}_steps_per_s",
         "value": round(steps_per_s, 1),
         "unit": "steps/s",
+        "mode": "microbench",
         "n_iters": max(1, iters),
         "steps": int(steps),
         "stop_code": int(code),
@@ -599,6 +642,7 @@ def bench_dual(num_reads, seq_len, error_rate, iters=5, trace_out=None):
         "value_median": round(tpu_time, 4),
         "n_iters": len(times),
         "unit": "s",
+        "mode": "dual",
         "vs_baseline": round(cpu_time / tpu_time, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(tpu_results == cpu_results),
@@ -692,6 +736,7 @@ def bench_priority(num_reads, seq_len, error_rate, iters=5, trace_out=None):
         "value_median": round(tpu_time, 4),
         "n_iters": len(times),
         "unit": "s",
+        "mode": "priority",
         "vs_baseline": round(cpu_time / tpu_time, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(tpu_result == cpu_result),
@@ -943,6 +988,110 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
     }
 
 
+def bench_explain(num_reads, seq_len, error_rate):
+    """Bottleneck explainer (``--explain``): ONE profiled single-engine
+    search with dense frontier sampling, rendered as a human-readable
+    timeline + per-kernel phase table on stderr (the evidence JSON line
+    still goes to stdout, carrying the raw samples).
+
+    This is the worked "where did the time go" flow the README
+    documents: the phase table says which kernel family and phase
+    dominates; the frontier timeline says what the search was doing
+    while it happened (queue growth, cost-gap collapse, speculative
+    commit-rate drops, ragged injections)."""
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.obs import phases as obs_phases
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    # much denser than the always-on default of 64: device-stepped
+    # searches finish in few pops, and the whole point here is timeline
+    # resolution
+    os.environ.setdefault("WAFFLE_FRONTIER_SAMPLE", "4")
+    obs_phases.enable_profiling(True)
+    min_count = max(2, num_reads // 4)
+    truth, reads = generate_test(4, seq_len, num_reads, error_rate,
+                                 seed=0)
+    cfg = (
+        CdwfaConfigBuilder()
+        .min_count(min_count)
+        .backend("jax")
+        .initial_band(_band_seed(seq_len, error_rate))
+        .build()
+    )
+    warm_start = time.perf_counter()
+    _make_engine("single", cfg, reads).consensus()  # absorb compiles
+    warm_s = time.perf_counter() - warm_start
+    obs_phases.reset()
+    obs_flight.reset()
+
+    engine = _make_engine("single", cfg, reads)
+    t0 = time.perf_counter()
+    results = engine.consensus()
+    wall = time.perf_counter() - t0
+
+    frontier = [
+        {k: v for k, v in r.items() if k not in ("ts", "kind", "trace_id")}
+        for r in obs_flight.get_recorder().records()
+        if r["kind"] == "frontier"
+    ]
+    snap = obs_phases.snapshot()
+    totals = obs_phases.totals()
+    total_s = sum(totals.values()) or 1e-9
+
+    err = sys.stderr
+    print("== dispatch phase breakdown (per kernel/op/K/geometry) ==",
+          file=err)
+    print(f"{'label':36s} {'count':>6s} {'mean_ms':>8s} "
+          f"{'prep':>7s} {'device':>7s} {'xfer':>7s} {'post':>7s}",
+          file=err)
+    for label, row in snap.items():
+        print(
+            f"{label:36s} {row['count']:6d} {row['mean_ms']:8.2f} "
+            f"{row['host_prep']:7.3f} {row['device_compute']:7.3f} "
+            f"{row['transfer']:7.3f} {row['host_post']:7.3f}",
+            file=err,
+        )
+    print("== where the dispatch time went ==", file=err)
+    for phase in ("host_prep", "device_compute", "transfer", "host_post"):
+        print(f"  {phase:15s} {totals[phase]:8.3f}s "
+              f"({100 * totals[phase] / total_s:5.1f}%)", file=err)
+    print(f"== search-frontier timeline ({len(frontier)} samples, every "
+          f"{os.environ['WAFFLE_FRONTIER_SAMPLE']} pops) ==", file=err)
+    print(f"{'t_s':>8s} {'pops':>7s} {'queue':>6s} {'live':>5s} "
+          f"{'cost':>6s} {'gap':>5s} {'len':>6s} {'far':>6s} "
+          f"{'commit':>7s}", file=err)
+    for s in frontier:
+        gap = s.get("gap")
+        commit = s.get("spec_commit_rate")
+        print(
+            f"{s['t_s']:8.3f} {s['pops']:7d} {s['queue']:6d} "
+            f"{s['live']:5d} {s['top_cost']:6d} "
+            f"{'-' if gap is None else gap:>5} {s['top_len']:6d} "
+            f"{s['farthest']:6d} "
+            f"{'-' if commit is None else f'{commit:.3f}':>7}",
+            file=err,
+        )
+
+    rep = getattr(engine, "last_search_report", None)
+    out = {
+        "metric": f"explain_{num_reads}x{seq_len}_wall_s",
+        "value": round(wall, 4),
+        "unit": "s",
+        "mode": "explain",
+        "warmup_incl_compile_s": round(warm_s, 2),
+        "n_results": len(results),
+        "frontier_sample_every": int(
+            os.environ["WAFFLE_FRONTIER_SAMPLE"]
+        ),
+        "frontier": frontier,
+        "phase_totals": {k: round(v, 6) for k, v in totals.items()},
+    }
+    if rep is not None:
+        out["search_report"] = rep.to_dict()
+    return out
+
+
 def _child_cmd(mode_args, platform):
     return [
         sys.executable,
@@ -977,6 +1126,10 @@ _BEST = {
     "unit": "s",
     "vs_baseline": 0,
     "parity": False,
+    # literal copy of perfdb.EVIDENCE_SCHEMA: _flush_best runs in signal
+    # context, where importing the stamper is off-limits
+    # (tests/test_evidence_schema.py pins the two in sync)
+    "schema": 2,
     "error": "no bench attempt completed",
 }
 _FLUSHED = False
@@ -1079,6 +1232,8 @@ def _north_star_orchestrated(args) -> None:
         timeout_s = min(cap, max(0, _remaining() - GATE_RESERVE_S))
         mode = ["--_run", "--reads", str(num_reads), "--len", str(seq_len),
                 "--iters", str(args.iters)]
+        if args.profile:
+            mode += ["--profile"]
         if args.trace:
             mode += ["--trace", args.trace]
         if args.trace_out:
@@ -1242,6 +1397,22 @@ def main() -> None:
         "the CI flight-recorder smoke",
     )
     parser.add_argument(
+        "--profile", action="store_true",
+        help="enable phase-attributed dispatch profiling (WAFFLE_PROFILE): "
+        "evidence lines grow a 'phases' histogram snapshot (host_prep / "
+        "device_compute / transfer / host_post per kernel family).  Adds "
+        "a device fence per dispatch, so timed numbers shift — never "
+        "combine with --assert-steps-floor comparisons against "
+        "unprofiled baselines",
+    )
+    parser.add_argument(
+        "--explain", action="store_true",
+        help="bottleneck explainer: one profiled single-engine search "
+        "with dense frontier sampling; prints a phase table + search-"
+        "frontier timeline to stderr and an mode=explain evidence line "
+        "to stdout",
+    )
+    parser.add_argument(
         "--platform", choices=("auto", "cpu", "device"), default="auto"
     )
     # hidden: one in-process bench attempt / gate run (orchestrator children)
@@ -1254,11 +1425,30 @@ def main() -> None:
 
     # in-process modes pin the backend themselves; the orchestrated default
     # never touches jax in the parent (children carry --platform)
+    if args.profile:
+        # env (not an import) so the orchestrated parent stays jax-free
+        # and subprocess children inherit it
+        os.environ["WAFFLE_PROFILE"] = "1"
+
     if args.platform == "cpu" and (
         args._run or args._gate or args.grid or args.dual or args.priority
-        or args.serve or args.serve_mix or args.microbench
+        or args.serve or args.serve_mix or args.microbench or args.explain
     ):
         _force_cpu_backend()
+
+    if args.explain:
+        from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
+        out = bench_explain(
+            args.reads or (16 if smoke else 64),
+            args.seq_len or (1000 if smoke else 2000),
+            0.01,
+        )
+        out["device_platform"] = _current_platform()
+        _emit(out, perfdb_kind="explain")
+        return
 
     if args.microbench:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
@@ -1272,7 +1462,7 @@ def main() -> None:
             iters=args.iters,
         )
         out["device_platform"] = _current_platform()
-        print(json.dumps(out))
+        _emit(out, perfdb_kind="microbench")
         if args.steps_floor is not None:
             ok = out["parity"] and out["value"] >= args.steps_floor
             if not ok:
@@ -1299,7 +1489,7 @@ def main() -> None:
             supervised=args.serve_supervised,
         )
         out["device_platform"] = _current_platform()
-        print(json.dumps(out))
+        _emit(out, perfdb_kind="serve")
         return
 
     if args.serve_mix:
@@ -1308,7 +1498,7 @@ def main() -> None:
         enable_compilation_cache()
         out = bench_serve_mix(args.serve_mix)
         out["device_platform"] = _current_platform()
-        print(json.dumps(out))
+        _emit(out, perfdb_kind="serve-mix")
         return
 
     if args._run:
@@ -1322,7 +1512,7 @@ def main() -> None:
                 trace_out=args.trace_out,
             )
             out["device_platform"] = _current_platform()
-            print(json.dumps(out))
+            _emit(out, perfdb_kind="north-star")
         except Exception:
             traceback.print_exc()
             sys.exit(1)
@@ -1361,7 +1551,7 @@ def main() -> None:
                         f"consensus_4x{seq_len}x{num_samples}_{error_rate}"
                     )
                     out["device_platform"] = _current_platform()
-                    print(json.dumps(out), flush=True)
+                    _emit(out)
         return
     if args.dual:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
@@ -1372,7 +1562,7 @@ def main() -> None:
             trace_out=args.trace_out,
         )
         out["device_platform"] = _current_platform()
-        print(json.dumps(out))
+        _emit(out, perfdb_kind="dual")
         return
     if args.priority:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
@@ -1383,7 +1573,7 @@ def main() -> None:
             trace_out=args.trace_out,
         )
         out["device_platform"] = _current_platform()
-        print(json.dumps(out))
+        _emit(out, perfdb_kind="priority")
         return
 
     _north_star_orchestrated(args)

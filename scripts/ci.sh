@@ -70,6 +70,24 @@ MICRO_FLOOR="${WAFFLE_MICROBENCH_FLOOR:-900}"
 python bench.py --microbench --platform cpu --iters 3 \
   --assert-steps-floor "$MICRO_FLOOR"
 
+echo "== perfdb (persistent perf history + rolling-baseline gate) =="
+# The microbench above appended its record to the perf database — a
+# retained artifact (evidence/perfdb.jsonl in the repo), not a
+# tmpfile.  The gate compares that latest record against the rolling
+# median of the prior same-platform runs with a tolerance band; the
+# absolute MICRO_FLOOR stays as the backstop for a drifted baseline.
+# Knobs:
+#   WAFFLE_PERFDB             database path (default evidence/perfdb.jsonl)
+#   WAFFLE_MICROBENCH_FLOOR   absolute steps/s backstop (default 900)
+#   WAFFLE_PERFDB_TOLERANCE   allowed fractional drop vs the rolling
+#                             baseline (default 0.05)
+#   WAFFLE_PERFDB_WINDOW      rolling-baseline window (default 10)
+python scripts/perf_report.py --check \
+  --tolerance "${WAFFLE_PERFDB_TOLERANCE:-0.05}" \
+  --window "${WAFFLE_PERFDB_WINDOW:-10}" \
+  --floor "$MICRO_FLOOR"
+python scripts/perf_report.py
+
 echo "== speculative K-sweep smoke (golden-fixture parity at K>1) =="
 # The speculative K-column device loop must be byte-identical to K=1
 # at every K. The fuzz suite pins the adversarial cases; this smoke

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Render the perfdb trend and gate the latest run against it.
+
+The perf database (``waffle_con_tpu/obs/perfdb.py``) is an append-only
+JSONL of schema-versioned records written by ``bench.py`` and
+``scripts/ci.sh``.  This script is its read side:
+
+* default: a per-(kind, metric) trend table of the recent history —
+  count, min/median/max, latest value, and delta vs the rolling
+  baseline (median of the prior ``--window`` records);
+
+* ``--check``: the CI regression gate.  The LATEST record of
+  ``--kind`` (default ``microbench``) must be within ``--tolerance``
+  (default 5%) of the rolling baseline computed over the records
+  BEFORE it, and above the absolute ``--floor`` backstop
+  (``WAFFLE_MICROBENCH_FLOOR``, default 900 — the same constant
+  ``scripts/ci.sh`` passes to ``--assert-steps-floor``).  Exit 1 on
+  breach.  With no prior history the baseline check is vacuous (first
+  run seeds the database) but the floor still applies.
+
+Values are throughput-style (higher is better) for every current
+record kind; the gate compares one-sided accordingly.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from waffle_con_tpu.obs import perfdb  # noqa: E402  (path bootstrap above)
+
+
+def _fmt(v):
+    return f"{v:.1f}" if isinstance(v, (int, float)) else str(v)
+
+
+def render_trend(records, limit):
+    by_series = {}
+    for rec in records:
+        key = (rec.get("kind", "?"), rec.get("metric", "?"))
+        by_series.setdefault(key, []).append(rec)
+    if not by_series:
+        print("perfdb is empty (run bench.py or scripts/ci.sh to seed it)")
+        return
+    print(f"{'kind':12s} {'metric':34s} {'n':>4s} {'min':>9s} "
+          f"{'median':>9s} {'max':>9s} {'latest':>9s} {'vs base':>8s}")
+    for (kind, metric), recs in sorted(by_series.items()):
+        values = [r["value"] for r in recs
+                  if isinstance(r.get("value"), (int, float))]
+        if not values:
+            continue
+        latest = values[-1]
+        base = perfdb.rolling_baseline(recs[:-1])
+        vs = f"{100 * (latest / base - 1):+6.1f}%" if base else "     --"
+        tail = values[-limit:]
+        srt = sorted(tail)
+        med = srt[len(srt) // 2]
+        print(f"{kind:12s} {metric[:34]:34s} {len(values):4d} "
+              f"{_fmt(min(tail)):>9s} {_fmt(med):>9s} {_fmt(max(tail)):>9s} "
+              f"{_fmt(latest):>9s} {vs:>8s}")
+
+
+def check(records, args):
+    recs = [r for r in records
+            if isinstance(r.get("value"), (int, float))
+            and (args.metric is None or r.get("metric") == args.metric)]
+    if not recs:
+        print(f"perfdb check: no {args.kind!r} records in "
+              f"{args.db} — nothing to gate (first run seeds the db)")
+        return 0
+    latest = recs[-1]
+    value = float(latest["value"])
+    # judge against same-platform history only: a cpu run gated
+    # against device steps/s (or vice versa) is always wrong
+    prior = [r for r in recs[:-1]
+             if r.get("platform") == latest.get("platform")]
+    base = perfdb.rolling_baseline(prior, window=args.window)
+    unit = latest.get("unit", "")
+    where = (f"{latest.get('kind')}/{latest.get('metric')} on "
+             f"{latest.get('platform', '?')}")
+    ok = True
+    if value < args.floor:
+        print(f"perfdb check FAIL: {where} latest {value} {unit} < "
+              f"absolute floor {args.floor}")
+        ok = False
+    if base is not None:
+        allowed = base * (1.0 - args.tolerance)
+        verdict = "ok" if value >= allowed else "FAIL"
+        print(f"perfdb check {verdict}: {where} latest {_fmt(value)} "
+              f"{unit} vs rolling baseline {_fmt(base)} "
+              f"(window {min(args.window, len(prior))}, "
+              f"tolerance {100 * args.tolerance:.0f}% -> "
+              f"allowed >= {_fmt(allowed)})")
+        ok = ok and value >= allowed
+    else:
+        print(f"perfdb check ok: {where} latest {_fmt(value)} {unit}, "
+              f"no prior history (floor {args.floor} passed)")
+    return 0 if ok else 1
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="perfdb trend report + CI regression gate"
+    )
+    parser.add_argument("--db", default=None,
+                        help="perfdb path (default: WAFFLE_PERFDB or "
+                        "evidence/perfdb.jsonl)")
+    parser.add_argument("--kind", default=None,
+                        help="filter to one record kind "
+                        "(--check defaults to 'microbench')")
+    parser.add_argument("--metric", default=None,
+                        help="filter to one metric name")
+    parser.add_argument("--limit", type=int, default=20,
+                        help="trend stats window per series (default 20)")
+    parser.add_argument("--check", action="store_true",
+                        help="gate the latest record vs the rolling "
+                        "baseline; exit 1 on breach")
+    parser.add_argument("--window", type=int, default=10,
+                        help="rolling-baseline window (default 10)")
+    parser.add_argument("--tolerance", type=float, default=0.05,
+                        help="allowed fractional drop vs the rolling "
+                        "baseline (default 0.05)")
+    parser.add_argument(
+        "--floor", type=float,
+        default=float(os.environ.get("WAFFLE_MICROBENCH_FLOOR", "900")),
+        help="absolute backstop floor (default: WAFFLE_MICROBENCH_FLOOR "
+        "or 900, matching ci.sh's --assert-steps-floor)",
+    )
+    args = parser.parse_args()
+    if args.check and args.kind is None:
+        args.kind = "microbench"
+
+    records = perfdb.load_records(args.db, kind=args.kind)
+    if args.metric is not None and not args.check:
+        records = [r for r in records if r.get("metric") == args.metric]
+    if args.check:
+        sys.exit(check(records, args))
+    render_trend(records, args.limit)
+
+
+if __name__ == "__main__":
+    main()

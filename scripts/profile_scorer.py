@@ -2,31 +2,70 @@
 """Profile the JaxScorer device loop: steps/sec of run_extend, growth
 events, and per-call wall time, at a configurable problem size.
 
-Obs integration: with ``WAFFLE_METRICS=1`` the scorer is wrapped in the
-obs ``TimedScorer`` and a registry snapshot (per-op dispatch latency
-histograms) is printed at the end; with ``WAFFLE_TRACE=<path>`` the
-nested dispatch/device-sync spans are written there as a Chrome trace
-at exit."""
+Usage: python scripts/profile_scorer.py [--reads R] [--len L]
+           [--chunk STEPS] [--platform cpu|device] [--profile]
+           [--perfdb / --no-perfdb]
 
+Obs integration: ``--profile`` (or ``WAFFLE_PROFILE=1``) turns on
+phase-attributed dispatch profiling and prints the per-kernel
+host-prep / device-compute / transfer / host-post breakdown at the
+end; with ``WAFFLE_METRICS=1`` the scorer is wrapped in the obs
+``TimedScorer`` and a registry snapshot (per-op dispatch latency
+histograms) is printed too; with ``WAFFLE_TRACE=<path>`` the nested
+dispatch/device-sync spans are written there as a Chrome trace at
+exit.  Unless ``--no-perfdb``, the run appends one ``profile``
+record (ms/symbol) to the perf database so the trajectory shows up
+in ``scripts/perf_report.py``.
+"""
+
+import argparse
+import os
 import pathlib
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from waffle_con_tpu.config import CdwfaConfigBuilder
-from waffle_con_tpu.obs import metrics_enabled, registry
-from waffle_con_tpu.obs.instrument import maybe_instrument
-from waffle_con_tpu.ops.jax_scorer import JaxScorer
-from waffle_con_tpu.utils.example_gen import generate_test
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--reads", type=int, default=64)
+    parser.add_argument("--len", type=int, dest="seq_len", default=2000)
+    parser.add_argument("--chunk", type=int, default=500,
+                        help="max device steps per run_extend call")
+    parser.add_argument("--platform", default="cpu",
+                        choices=["cpu", "device"])
+    parser.add_argument("--profile", action="store_true",
+                        help="phase-attributed dispatch profiling "
+                        "(WAFFLE_PROFILE)")
+    parser.add_argument("--perfdb", action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="append a perfdb record (default on)")
+    return parser.parse_args(argv)
 
 
-def main():
-    R = int(sys.argv[1]) if len(sys.argv) > 1 else 64
-    L = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
-    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 500
+# Parse BEFORE anything imports jax: the platform pin must be decided
+# by real argparse semantics, and setting JAX_PLATFORMS in the env
+# ahead of the import pins it however late the backend initializes.
+if __name__ == "__main__":
+    _ARGS = _parse_args()
+    if _ARGS.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if _ARGS.profile:
+        os.environ["WAFFLE_PROFILE"] = "1"
+
+import numpy as np  # noqa: E402
+
+from waffle_con_tpu.config import CdwfaConfigBuilder  # noqa: E402
+from waffle_con_tpu.obs import metrics_enabled, registry  # noqa: E402
+from waffle_con_tpu.obs import phases as obs_phases  # noqa: E402
+from waffle_con_tpu.obs.instrument import maybe_instrument  # noqa: E402
+from waffle_con_tpu.ops.jax_scorer import JaxScorer  # noqa: E402
+from waffle_con_tpu.utils.example_gen import generate_test  # noqa: E402
+
+
+def main(args):
+    R, L, chunk = args.reads, args.seq_len, args.chunk
     err = 0.01
     mc = max(2, R // 4)
     truth, reads = generate_test(4, L, R, err, seed=0)
@@ -69,13 +108,40 @@ def main():
         if len(cons) > L + 200:
             break
     total = time.perf_counter() - t_all
+    ms_per_symbol = total / max(len(cons), 1) * 1e3
     print(
         f"TOTAL: {total:.2f}s for {len(cons)} symbols in {calls} calls "
-        f"({total/max(len(cons),1)*1e3:.3f} ms/symbol), final E={sc.bucket_e}"
+        f"({ms_per_symbol:.3f} ms/symbol), final E={sc.bucket_e}"
     )
+    if obs_phases.profiling_enabled():
+        print("phase breakdown (per kernel/op/K/geometry):")
+        for label, row in obs_phases.snapshot().items():
+            print(
+                f"  {label:36s} n={row['count']:4d} "
+                f"mean={row['mean_ms']:.2f}ms "
+                f"prep={row['host_prep']:.3f}s "
+                f"dev={row['device_compute']:.3f}s "
+                f"xfer={row['transfer']:.3f}s "
+                f"post={row['host_post']:.3f}s"
+            )
     if metrics_enabled():
         print(registry().render_prometheus(), end="")
+    if args.perfdb:
+        from waffle_con_tpu.obs import perfdb
+
+        rec = perfdb.make_record(
+            "profile", f"profile_{R}x{L}_ms_per_symbol",
+            round(ms_per_symbol, 4), "ms/symbol",
+            platform=args.platform, calls=calls,
+            symbols=len(cons), chunk=chunk,
+        )
+        if obs_phases.profiling_enabled():
+            rec["phases"] = {
+                k: round(v, 6) for k, v in obs_phases.totals().items()
+            }
+        path = perfdb.append_record(rec)
+        print(f"perfdb: appended profile record to {path}")
 
 
 if __name__ == "__main__":
-    main()
+    main(_ARGS)

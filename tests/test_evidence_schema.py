@@ -1,0 +1,206 @@
+"""Bench evidence schema + perfdb contracts.
+
+Three contracts pinned here:
+
+* the evidence lines ``bench.py`` prints are schema-versioned
+  (``perfdb.EVIDENCE_SCHEMA``) and :func:`perfdb.load_evidence`
+  validates per-mode required fields and rejects unknown majors;
+* every evidence field ``scripts/ci.sh`` hard-indexes
+  (``evidence["..."]``) is declared in the
+  :data:`perfdb.EVIDENCE_MODE_FIELDS` contract table — so ci.sh
+  growing a new assert without updating the table fails tier-1, not
+  the next CI run;
+* the perfdb JSONL round-trips: schema-stamped append, torn-line and
+  future-major tolerance on load, rolling-baseline median math.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from waffle_con_tpu.obs import perfdb
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CI_SH = os.path.join(_ROOT, "scripts", "ci.sh")
+
+
+# -------------------------------------------------- ci.sh field contract
+
+
+def test_ci_sh_reads_only_declared_evidence_fields():
+    with open(_CI_SH) as fh:
+        src = fh.read()
+    read_fields = set(re.findall(r"""evidence\[["'](\w+)["']\]""", src))
+    assert read_fields, "ci.sh no longer hard-indexes evidence fields?"
+    declared = set(perfdb.EVIDENCE_REQUIRED)
+    for fields in perfdb.EVIDENCE_MODE_FIELDS.values():
+        declared.update(fields)
+    # trace-enabled smoke extras: present because ci.sh runs the bench
+    # with --trace-out / WAFFLE_METRICS, not mode-required fields
+    declared.update({"metrics", "search_report"})
+    undeclared = read_fields - declared
+    assert not undeclared, (
+        f"ci.sh reads evidence fields {sorted(undeclared)} that "
+        f"perfdb.EVIDENCE_MODE_FIELDS does not declare — update the "
+        f"contract table (and load_evidence validation) first"
+    )
+
+
+def test_best_fallback_literal_matches_evidence_schema():
+    # bench._BEST is flushed from signal context, so it carries the
+    # schema as a literal instead of calling stamp_evidence; pin the
+    # literal to the constant so a bump can't silently miss it
+    import bench
+
+    assert bench._BEST["schema"] == perfdb.EVIDENCE_SCHEMA
+
+
+# --------------------------------------------------- evidence validation
+
+
+def _microbench_line(**overrides):
+    line = {
+        "metric": "hotloop_steps_per_s",
+        "value": 1048.1,
+        "unit": "steps/s",
+        "mode": "microbench",
+        "parity": True,
+        "steps": 9983,
+        "stop_code": 2,
+        "breakdown": {"run_cols": 4},
+        "schema": perfdb.EVIDENCE_SCHEMA,
+    }
+    line.update(overrides)
+    return line
+
+
+def test_load_evidence_accepts_current_schema():
+    out = perfdb.load_evidence(json.dumps(_microbench_line()))
+    assert out["value"] == 1048.1
+
+
+def test_load_evidence_missing_required_field():
+    bad = _microbench_line()
+    del bad["unit"]
+    with pytest.raises(ValueError, match="unit"):
+        perfdb.load_evidence(bad)
+
+
+def test_load_evidence_missing_mode_field():
+    bad = _microbench_line()
+    del bad["stop_code"]
+    with pytest.raises(ValueError, match="stop_code"):
+        perfdb.load_evidence(bad)
+
+
+def test_load_evidence_rejects_newer_major():
+    with pytest.raises(ValueError, match="newer"):
+        perfdb.load_evidence(_microbench_line(schema=99))
+
+
+def test_load_evidence_rejects_nonsense_major():
+    with pytest.raises(ValueError, match="nonsense"):
+        perfdb.load_evidence(_microbench_line(schema=0))
+
+
+def test_load_evidence_missing_schema_is_legacy_major_one():
+    # pre-observatory line: no schema field, none of the newer-major
+    # guarantees — parses without field checks
+    legacy = {"metric": "x", "value": 1}
+    assert perfdb.load_evidence(json.dumps(legacy))["metric"] == "x"
+
+
+def test_load_evidence_rejects_non_object():
+    with pytest.raises(ValueError):
+        perfdb.load_evidence("[1, 2]")
+
+
+def test_stamp_evidence_sets_schema():
+    out = perfdb.stamp_evidence({"metric": "m"})
+    assert out["schema"] == perfdb.EVIDENCE_SCHEMA
+
+
+def test_every_mode_contract_includes_required_fields_disjointly():
+    # the mode tables list only mode-SPECIFIC fields; the cross-mode
+    # invariants live in EVIDENCE_REQUIRED alone
+    for mode, fields in perfdb.EVIDENCE_MODE_FIELDS.items():
+        overlap = set(fields) & set(perfdb.EVIDENCE_REQUIRED)
+        assert not overlap, (mode, overlap)
+
+
+# --------------------------------------------------------- perfdb jsonl
+
+
+def test_perfdb_round_trip(tmp_path):
+    db = tmp_path / "perf.jsonl"
+    rec = perfdb.make_record(
+        "microbench", "hotloop_steps_per_s", 1048.1, "steps/s",
+        platform="cpu", run_cols=4,
+    )
+    assert rec["schema"] == perfdb.SCHEMA
+    assert rec["unix_time"] > 0 and rec["host"]
+    path = perfdb.append_record(rec, str(db))
+    assert path == str(db)
+    loaded = perfdb.load_records(str(db))
+    assert len(loaded) == 1
+    assert loaded[0]["value"] == 1048.1
+    assert loaded[0]["run_cols"] == 4
+
+
+def test_perfdb_append_refuses_wrong_schema(tmp_path):
+    with pytest.raises(ValueError, match="refusing"):
+        perfdb.append_record({"schema": 99, "value": 1},
+                             str(tmp_path / "x.jsonl"))
+
+
+def test_perfdb_load_skips_torn_and_future_lines(tmp_path):
+    db = tmp_path / "perf.jsonl"
+    good = perfdb.make_record("microbench", "m", 10.0, "steps/s")
+    with open(db, "w") as fh:
+        fh.write(json.dumps(good) + "\n")
+        fh.write('{"schema": 1, "kind": "microbench", "val')  # torn
+        fh.write("\n")
+        fh.write(json.dumps({**good, "schema": perfdb.SCHEMA + 1,
+                             "value": 999.0}) + "\n")
+        fh.write("[1,2,3]\n")  # not an object
+        fh.write(json.dumps({**good, "value": 20.0}) + "\n")
+    loaded = perfdb.load_records(str(db))
+    assert [r["value"] for r in loaded] == [10.0, 20.0]
+
+
+def test_perfdb_load_missing_file_is_empty(tmp_path):
+    assert perfdb.load_records(str(tmp_path / "nope.jsonl")) == []
+
+
+def test_perfdb_kind_filter(tmp_path):
+    db = str(tmp_path / "perf.jsonl")
+    perfdb.append_record(
+        perfdb.make_record("microbench", "m", 1.0, "u"), db)
+    perfdb.append_record(
+        perfdb.make_record("serve", "s", 2.0, "u"), db)
+    assert [r["kind"] for r in perfdb.load_records(db, kind="serve")] \
+        == ["serve"]
+
+
+def test_perfdb_default_path_env_override(monkeypatch, tmp_path):
+    monkeypatch.setenv("WAFFLE_PERFDB", str(tmp_path / "db.jsonl"))
+    assert perfdb.default_path() == str(tmp_path / "db.jsonl")
+    monkeypatch.delenv("WAFFLE_PERFDB")
+    assert perfdb.default_path().endswith(
+        os.path.join("evidence", "perfdb.jsonl"))
+
+
+def test_rolling_baseline_median_math():
+    recs = [{"value": v, "metric": "m"} for v in (10, 30, 20)]
+    assert perfdb.rolling_baseline(recs) == 20  # odd: middle
+    recs.append({"value": 40, "metric": "m"})
+    assert perfdb.rolling_baseline(recs) == 25  # even: mean of middles
+    # window keeps only the tail
+    assert perfdb.rolling_baseline(recs, window=2) == 30  # of (20, 40)
+    # metric filter + non-numeric tolerance
+    recs.append({"value": "bogus", "metric": "m"})
+    recs.append({"value": 1000, "metric": "other"})
+    assert perfdb.rolling_baseline(recs, metric="m") == 25
+    assert perfdb.rolling_baseline([], metric="m") is None

@@ -497,6 +497,43 @@ def test_flight_trigger_dedupes_and_stays_in_memory(slo_clean, tmp_path,
     assert len(flight.incidents()) == 2
 
 
+def test_flight_dedupe_window_expires(slo_clean, monkeypatch):
+    """Dedupe is a rolling window, not forever: the same (reason,
+    trace) re-fires once the window has passed, and window 0 disables
+    dedupe entirely."""
+    from waffle_con_tpu.obs import flight
+    from waffle_con_tpu.obs.flight import FlightRecorder
+
+    monkeypatch.delenv("WAFFLE_FLIGHT_DIR", raising=False)
+    rec = FlightRecorder(dedupe_s=10.0)
+    t = [1000.0]
+    monkeypatch.setattr(flight.time, "time", lambda: t[0])
+    assert rec.trigger("slow_search", trace_id="job-1") is not None
+    t[0] += 5.0  # inside the window: suppressed
+    assert rec.trigger("slow_search", trace_id="job-1") is None
+    t[0] += 6.0  # 11s after the first fire: window expired, re-fires
+    assert rec.trigger("slow_search", trace_id="job-1") is not None
+    assert len(rec.incidents()) == 2
+
+    zero = FlightRecorder(dedupe_s=0.0)
+    assert zero.trigger("slow_search", trace_id="j") is not None
+    assert zero.trigger("slow_search", trace_id="j") is not None
+
+
+def test_flight_dedupe_window_env_knob(slo_clean, monkeypatch):
+    from waffle_con_tpu.obs.flight import (
+        DEFAULT_DEDUPE_S,
+        _dedupe_window_s,
+    )
+
+    monkeypatch.delenv("WAFFLE_FLIGHT_DEDUPE_S", raising=False)
+    assert _dedupe_window_s() == DEFAULT_DEDUPE_S == 300.0
+    monkeypatch.setenv("WAFFLE_FLIGHT_DEDUPE_S", "7.5")
+    assert _dedupe_window_s() == 7.5
+    monkeypatch.setenv("WAFFLE_FLIGHT_DEDUPE_S", "bogus")
+    assert _dedupe_window_s() == DEFAULT_DEDUPE_S
+
+
 def test_flight_dump_writes_parseable_incident(slo_clean, tmp_path,
                                                monkeypatch):
     from waffle_con_tpu.obs import flight

@@ -1,0 +1,273 @@
+"""Phase-attributed dispatch profiling (``obs.phases``) and the
+search-frontier sampler (``obs.instrument.FrontierSampler``).
+
+Core claims under test:
+
+* conservation — for an eagerly-synced dispatch
+  (``WAFFLE_ASYNC_SYNC=0``) the four phases (host_prep /
+  device_compute / transfer / host_post) sum to the dispatch wall time
+  within 5%, for the solo, dual, AND ragged kernel families;
+* zero overhead when disabled — ``begin`` returns ``None``, the scopes
+  are the shared no-op singleton, and nothing aggregates;
+* the outermost dispatch wins when proxy layers stack;
+* a ``DeferredStats`` resolve landing after its dispatch closed is
+  flagged ``late`` and still folded into the aggregate as transfer;
+* the engines publish per-search phase deltas
+  (``report.extra["phases"]``) and decimated frontier samples into the
+  flight ring.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder, ConsensusDWFA
+from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.obs import phases
+from waffle_con_tpu.obs.instrument import (
+    FrontierSampler,
+    maybe_instrument,
+)
+from waffle_con_tpu.ops import ragged
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.utils.example_gen import generate_test
+
+BUDGET = 2**31 - 1
+
+
+@pytest.fixture
+def profiling(monkeypatch):
+    """Profiling on, eager stats sync (conservation is exact there),
+    clean slate before and after."""
+    monkeypatch.setenv("WAFFLE_ASYNC_SYNC", "0")
+    phases.enable_profiling(True)
+    phases.reset()
+    yield
+    phases.reset()
+    phases.reset_profiling_enabled()
+
+
+def _timed_scorer(reads):
+    cfg = CdwfaConfigBuilder().min_count(2).backend("jax").build()
+    return maybe_instrument(JaxScorer(reads, cfg), "jax")
+
+
+def _assert_conserved(rec):
+    ph = rec.phases()
+    total = sum(ph.values())
+    assert rec.wall_s > 0.0
+    assert abs(total - rec.wall_s) <= 0.05 * rec.wall_s + 1e-6, (
+        rec.op, rec.wall_s, ph,
+    )
+
+
+# -------------------------------------------------------- conservation
+
+
+def test_solo_dispatch_phases_conserve(profiling):
+    _, reads = generate_test(4, 200, 6, 0.01, seed=0)
+    sc = _timed_scorer(reads)
+    h = sc.root(np.ones(len(reads), dtype=bool))
+    steps, code, app, _stats, _recs = sc.run_extend(
+        h, b"", BUDGET, BUDGET, 0, 2, False, 64
+    )
+    assert steps > 0
+    runs = [r for r in phases.recent_records() if r.op == "run"]
+    assert runs, [r.op for r in phases.recent_records()]
+    rec = runs[-1]
+    assert rec.kernel in ("solo", "pallas")
+    assert rec.geom.startswith("B")
+    assert rec.device_s > 0.0  # the fence attributed kernel time
+    _assert_conserved(rec)
+
+
+def test_dual_dispatch_phases_conserve(profiling):
+    _, reads1 = generate_test(4, 150, 6, 0.01, seed=1)
+    _, reads2 = generate_test(4, 150, 6, 0.01, seed=2)
+    sc = _timed_scorer(list(reads1) + list(reads2))
+    n = len(reads1) + len(reads2)
+    ha = sc.root(np.ones(n, dtype=bool))
+    hb = sc.root(np.ones(n, dtype=bool))
+    out = sc.run_extend_dual(
+        ha, hb, b"", b"",
+        me_budget=BUDGET, other_cost=BUDGET, other_len=0,
+        min_count=2, ed_delta=2, imb_min=4, l2=False,
+        weighted=False, max_steps=32,
+    )
+    assert out[0] > 0  # steps
+    duals = [r for r in phases.recent_records() if r.op == "run_dual"]
+    assert duals, [r.op for r in phases.recent_records()]
+    rec = duals[-1]
+    assert rec.kernel in ("dual", "pallas")
+    assert rec.device_s > 0.0
+    _assert_conserved(rec)
+
+
+@pytest.mark.serve
+def test_ragged_group_phases_conserve(profiling, monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED", "1")
+    ragged.reset_arena()
+    try:
+        jobs = [
+            generate_test(4, 100, 5, 0.02, seed=s)[1] for s in (1, 2)
+        ]
+        with ragged.serve_scope():
+            scorers = [JaxScorer(r, CdwfaConfig()) for r in jobs]
+        handles = [
+            s.root(np.ones(len(j), bool)) for s, j in zip(scorers, jobs)
+        ]
+        args_list = [
+            (h, b"", BUDGET, BUDGET, 0, 2, False, 8) for h in handles
+        ]
+        specs = []
+        for s, a in zip(scorers, args_list):
+            spec = ragged.probe((s.ragged_run_probe, a, {}))
+            assert spec is not None
+            specs.append(spec)
+        keys = ragged.run_group(specs)
+        assert len(keys) == len(specs)
+        groups = [
+            r for r in phases.recent_records() if r.op == "ragged_group"
+        ]
+        assert groups, [r.op for r in phases.recent_records()]
+        rec = groups[-1]
+        assert rec.kernel == "ragged"
+        assert rec.geom.startswith("P")
+        assert rec.device_s > 0.0
+        _assert_conserved(rec)
+    finally:
+        ragged.reset_arena()
+
+
+# --------------------------------------------- enable/disable contract
+
+
+def test_disabled_begin_returns_none_and_nothing_aggregates():
+    phases.reset_profiling_enabled()
+    phases.reset()
+    assert not phases.profiling_enabled()
+    assert phases.begin("run", "jax") is None
+    assert phases.device_scope(None) is phases.NULL_SCOPE
+    assert phases.transfer_scope(None) is phases.NULL_SCOPE
+    with phases.device_scope(None):
+        pass
+    assert phases.totals() == {p: 0.0 for p in phases.PHASES}
+    assert phases.snapshot() == {}
+
+
+def test_disabled_timed_scorer_is_unwrapped(monkeypatch):
+    monkeypatch.delenv("WAFFLE_PROFILE", raising=False)
+    monkeypatch.delenv("WAFFLE_METRICS", raising=False)
+    phases.reset_profiling_enabled()
+    _, reads = generate_test(4, 60, 4, 0.0, seed=0)
+    cfg = CdwfaConfigBuilder().min_count(2).backend("jax").build()
+    sc = maybe_instrument(JaxScorer(reads, cfg), "jax")
+    assert isinstance(sc, JaxScorer)  # no proxy when everything is off
+
+
+def test_profiling_enables_timed_scorer(profiling):
+    _, reads = generate_test(4, 60, 4, 0.0, seed=0)
+    cfg = CdwfaConfigBuilder().min_count(2).backend("jax").build()
+    sc = maybe_instrument(JaxScorer(reads, cfg), "jax")
+    assert not isinstance(sc, JaxScorer)
+
+
+def test_outermost_dispatch_wins(profiling):
+    outer = phases.begin("run", "jax")
+    assert outer is not None
+    assert phases.current() is outer
+    assert phases.begin("stats", "jax") is None  # nested: suppressed
+    phases.end(outer)
+    assert phases.current() is None
+    snap = phases.snapshot()
+    assert list(snap) == ["other/run/k1"]
+    assert snap["other/run/k1"]["count"] == 1
+
+
+def test_late_transfer_is_flagged_and_aggregated(profiling):
+    rec = phases.begin("run", "jax")
+    rec.annotate(kernel="solo", k=2, geom="B4R8W16")
+    with phases.device_scope(rec):
+        pass
+    phases.end(rec)
+    before = phases.totals()["transfer"]
+    rec.add_transfer(0.25, 0.0)  # DeferredStats resolving after close
+    assert rec.late is True
+    assert phases.totals()["transfer"] - before == pytest.approx(0.25)
+
+
+def test_snapshot_labels_and_mean(profiling):
+    rec = phases.begin("run", "jax")
+    rec.annotate(kernel="arena", k=4, geom="B8R32W64")
+    phases.end(rec)
+    snap = phases.snapshot()
+    assert "arena/run/k4/B8R32W64" in snap
+    row = snap["arena/run/k4/B8R32W64"]
+    assert row["count"] == 1
+    assert row["mean_ms"] == pytest.approx(row["wall_s"] * 1e3, rel=1e-3)
+
+
+# ---------------------------------------------------- frontier sampler
+
+
+def test_frontier_sampler_interval_and_record(monkeypatch):
+    monkeypatch.setenv("WAFFLE_FRONTIER_SAMPLE", "8")
+    obs_flight.reset()
+    sampler = FrontierSampler("single")
+    assert sampler.interval == 8
+    assert not sampler.due(7)
+    assert sampler.due(8) and sampler.due(16)
+    sampler.sample(
+        8, queue_depth=12, live_branches=3, top_cost=5, next_cost=9,
+        top_len=40, farthest=41,
+        counters={"run_steps": 90, "run_spec_cols": 100,
+                  "run_ragged_injected": 2},
+    )
+    assert sampler.samples_taken == 1
+    recs = [
+        r for r in obs_flight.get_recorder().records()
+        if r["kind"] == "frontier"
+    ]
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["engine"] == "single"
+    assert r["pops"] == 8 and r["queue"] == 12 and r["live"] == 3
+    assert r["gap"] == 4  # next_cost - top_cost
+    assert r["spec_commit_rate"] == pytest.approx(0.9)
+    assert r["ragged_injected"] == 2
+    obs_flight.reset()
+
+
+def test_frontier_sampler_disabled_by_zero(monkeypatch):
+    monkeypatch.setenv("WAFFLE_FRONTIER_SAMPLE", "0")
+    sampler = FrontierSampler("dual")
+    assert not any(sampler.due(p) for p in range(1, 200))
+
+
+# ------------------------------------------------- engine integration
+
+
+def test_engine_search_publishes_phases_and_frontier(profiling,
+                                                     monkeypatch):
+    monkeypatch.setenv("WAFFLE_FRONTIER_SAMPLE", "1")
+    obs_flight.reset()
+    _, reads = generate_test(4, 120, 6, 0.01, seed=5)
+    cfg = CdwfaConfigBuilder().min_count(2).backend("jax").build()
+    engine = ConsensusDWFA(cfg)
+    for r in reads:
+        engine.add_sequence(r)
+    results = engine.consensus()
+    assert results
+    report = engine.last_search_report
+    ph = report.extra.get("phases")
+    assert ph, report.extra
+    assert set(ph) == set(phases.PHASES)
+    assert sum(ph.values()) > 0.0
+    frontier = [
+        r for r in obs_flight.get_recorder().records()
+        if r["kind"] == "frontier"
+    ]
+    assert frontier
+    assert all(r["engine"] == "single" for r in frontier)
+    assert frontier[-1]["pops"] >= frontier[0]["pops"]
+    obs_flight.reset()

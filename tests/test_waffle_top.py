@@ -1,0 +1,144 @@
+"""``scripts/waffle_top.py`` rendering: the top-style dashboard must
+render a service stats payload (the ``WAFFLE_STATS_FILE`` JSON the
+serve layer publishes) without a live service — pure fixture in,
+panel text out — and the CLI ``--once`` path must round-trip a file.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "waffle_top.py",
+)
+
+
+def _load_waffle_top():
+    spec = importlib.util.spec_from_file_location("waffle_top", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def waffle_top():
+    return _load_waffle_top()
+
+
+def _payload():
+    """A representative stats file: the shape serve/service.py writes
+    (jobs + dispatch occupancy + SLO windows + metrics + incidents)."""
+    return {
+        "unix_time": 1700000000.0,
+        "service": "waffle-serve",
+        "stats": {
+            "jobs": {
+                "submitted": 12, "done": 9, "failed": 1,
+                "expired": 0, "cancelled": 0, "rejected": 2,
+            },
+            "queue_depth": 3,
+            "dispatch": {
+                "batches": 40, "coalesced_batches": 25,
+                "direct_dispatches": 15,
+                "mean_batch_occupancy": 2.75, "occupancy_max": 6,
+            },
+        },
+        "slo": {
+            "k": 4.0,
+            "slow_searches": 1,
+            "dispatch": {
+                "count": 200, "p50_s": 0.004, "p95_s": 0.02,
+                "p99_s": 0.05, "ewma_s": 0.006,
+            },
+            "job": {
+                "count": 9, "p50_s": 0.8, "p95_s": 2.5,
+                "p99_s": 3.0, "ewma_s": 1.1,
+            },
+        },
+        "metrics": {
+            "waffle_dispatch_latency_seconds": {
+                "series": {
+                    'backend="jax",op="run"': {
+                        "count": 150, "sum": 1.5,
+                    },
+                    'backend="jax",op="stats"': {
+                        "count": 50, "sum": 0.1,
+                    },
+                },
+            },
+        },
+        "incidents": [
+            {
+                "unix_time": 1699999990.0,
+                "reason": "backend_demoted",
+                "trace_id": "job-7",
+                "path": None,
+            },
+        ],
+    }
+
+
+def test_render_panels_from_fixture(waffle_top):
+    out = waffle_top.render(_payload(), plain=True)
+    assert "\x1b[" not in out  # plain mode: no ANSI escapes
+    assert "service 'waffle-serve'" in out
+    assert "submitted=12" in out and "done=9" in out
+    assert "rejected=2" in out and "queue_depth=3" in out
+    assert "coalesced=25" in out and "mean_occupancy=2.75" in out
+    assert "slow_searches=1" in out
+    assert "p95=20.0ms" in out  # dispatch window
+    assert "p95=2.50s" in out  # job window
+    assert 'backend="jax",op="run"' in out
+    assert "mean=10.0ms" in out  # 1.5s / 150
+    assert "backend_demoted" in out and "trace=job-7" in out
+    assert "(in-memory)" in out
+
+
+def test_render_minimal_payload_does_not_crash(waffle_top):
+    out = waffle_top.render({}, plain=True)
+    assert "waffle_top" in out
+    assert "recent incidents (0)" in out
+    assert "none" in out
+
+
+def test_render_styled_mode_uses_ansi(waffle_top):
+    assert "\x1b[1m" in waffle_top.render(_payload(), plain=False)
+
+
+def test_cli_once_round_trips_stats_file(tmp_path):
+    stats = tmp_path / "stats.json"
+    stats.write_text(json.dumps(_payload()))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(stats), "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "waffle_top" in proc.stdout
+    assert "submitted=12" in proc.stdout
+
+
+def test_cli_once_missing_file_exits_nonzero(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(tmp_path / "absent.json"),
+         "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "waiting for" in proc.stdout
+
+
+def test_cli_env_var_supplies_stats_file(tmp_path):
+    stats = tmp_path / "stats.json"
+    stats.write_text(json.dumps(_payload()))
+    env = dict(os.environ, WAFFLE_STATS_FILE=str(stats))
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, "--once"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "queue_depth=3" in proc.stdout

@@ -28,6 +28,7 @@ import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
@@ -455,6 +456,7 @@ class ConsensusDWFA:
 
         results: List[Consensus] = []
         pops = 0
+        frontier = FrontierSampler("single")
 
         while not pqueue.is_empty():
             peak_queue_size = max(peak_queue_size, len(pqueue))
@@ -478,6 +480,14 @@ class ConsensusDWFA:
                     obs_metrics.registry().gauge(
                         "waffle_search_queue_depth", engine="single"
                     ).set(len(pqueue))
+            if frontier.due(pops):
+                next_prio = pqueue.peek_priority()
+                frontier.sample(
+                    pops, len(pqueue), len(tracker), -priority[0],
+                    -next_prio[0] if next_prio is not None else None,
+                    len(node.consensus), farthest_consensus,
+                    counters=getattr(scorer, "counters", None),
+                )
             top_cost = -priority[0]
             top_len = len(node.consensus)
             tracker.remove(top_len)

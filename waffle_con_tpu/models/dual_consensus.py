@@ -19,6 +19,7 @@ import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.models.consensus import (
     PROGRESS_LOG_INTERVAL,
@@ -464,6 +465,7 @@ class DualConsensusDWFA:
             )
 
         pops = 0
+        frontier = FrontierSampler("dual")
         while not pqueue.is_empty():
             peak_queue_size = max(peak_queue_size, len(pqueue))
             while (
@@ -491,6 +493,17 @@ class DualConsensusDWFA:
                     obs_metrics.registry().gauge(
                         "waffle_search_queue_depth", engine="dual"
                     ).set(len(pqueue))
+            if frontier.due(pops):
+                next_prio = pqueue.peek_priority()
+                frontier.sample(
+                    pops, len(pqueue),
+                    len(single_tracker) + len(dual_tracker),
+                    -priority[0],
+                    -next_prio[0] if next_prio is not None else None,
+                    node.max_consensus_length(),
+                    max(farthest_single, farthest_dual),
+                    counters=getattr(scorer, "counters", None),
+                )
             top_cost = -priority[0]
             top_len = node.max_consensus_length()
 

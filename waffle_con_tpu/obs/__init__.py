@@ -16,6 +16,17 @@ disabled:
 * :mod:`~waffle_con_tpu.obs.report` — :class:`SearchReport`, the
   structured per-search summary every engine stores as
   ``last_search_report`` and ``bench.py`` embeds in evidence JSON.
+* :mod:`~waffle_con_tpu.obs.phases` — phase-attributed dispatch
+  profiling (``WAFFLE_PROFILE=1``): every dispatch split into
+  host-prep / device-compute / transfer / host-post, labeled by kernel
+  family, speculative K, and geometry bucket; rolled into
+  ``SearchReport.extra`` and the ``bench.py`` evidence ``phases``
+  summary.
+* :mod:`~waffle_con_tpu.obs.perfdb` — append-only JSONL performance
+  history (``evidence/perfdb.jsonl`` / ``WAFFLE_PERFDB``); every bench
+  and CI run appends a schema-versioned record, ``scripts/
+  perf_report.py`` renders the trend, and the CI steps/s gate reads
+  its rolling baseline from it.
 
 Two **always-on** pieces ride alongside (both lock-cheap by design;
 the hot-loop 620 steps/s floor gates their overhead):
@@ -60,6 +71,12 @@ from waffle_con_tpu.obs.flight import (  # noqa: F401
     TRIGGER_REASONS,
     get_recorder,
 )
+from waffle_con_tpu.obs.phases import (  # noqa: F401
+    DispatchRecord,
+    enable_profiling,
+    profiling_enabled,
+    reset_profiling_enabled,
+)
 from waffle_con_tpu.obs.report import SearchReport  # noqa: F401
 from waffle_con_tpu.obs.slo import SloTracker  # noqa: F401
 from waffle_con_tpu.obs.trace import (  # noqa: F401
@@ -79,4 +96,4 @@ from waffle_con_tpu.obs.trace import (  # noqa: F401
 def obs_enabled() -> bool:
     """Whether any observability pipeline is recording (the gate for
     installing dispatch instrumentation)."""
-    return metrics_enabled() or tracing_enabled()
+    return metrics_enabled() or tracing_enabled() or profiling_enabled()

@@ -23,15 +23,20 @@ metrics are on), and the rolling SLO snapshot.  With
 stay in memory only (:meth:`FlightRecorder.incidents`) so test and
 library runs never litter the working directory.
 
-Incidents are deduplicated on ``(reason, trace_id)`` — a retry storm
-produces one dump, not hundreds.  ``WAFFLE_FLIGHT_RING`` sizes the ring
+Incidents are deduplicated on ``(reason, trace_id)`` within a rolling
+time window — a retry storm produces one dump, not hundreds, but a
+RECURRING incident re-fires once the window expires (a suppressed-
+forever dedupe hid every recurrence after the first).
+``WAFFLE_FLIGHT_DEDUPE_S`` sets the window (default 300 s; ``0``
+disables dedupe entirely).  ``WAFFLE_FLIGHT_RING`` sizes the ring
 (default 2048 records).
 
-Overhead contract: the microbench/raw-engine path makes **zero** calls
-into this module (recording happens at serve-layer dispatch and job
-boundaries plus anomaly sites), so the 620 steps/s hot-loop floor is
-unaffected by construction; in the serving path a record is one deque
-append.
+Overhead contract: the microbench/raw-dispatch path makes **zero**
+calls into this module (recording happens at serve-layer dispatch and
+job boundaries, anomaly sites, and the engines' frontier sampler —
+which is decimated to one record per ``WAFFLE_FRONTIER_SAMPLE`` queue
+pops, 0 to disable), so the 620 steps/s hot-loop floor is unaffected
+by construction; a record is one deque append.
 """
 
 from __future__ import annotations
@@ -57,6 +62,8 @@ DEFAULT_RING_SIZE = 2048
 #: in-memory incident cap (dumped files are bounded by dedupe instead)
 MAX_INCIDENTS = 64
 INCIDENT_SCHEMA = "waffle-flight-incident/1"
+#: default (reason, trace_id) dedupe window in seconds
+DEFAULT_DEDUPE_S = 300.0
 
 
 def _ring_size() -> int:
@@ -65,6 +72,14 @@ def _ring_size() -> int:
                            DEFAULT_RING_SIZE))
     except ValueError:
         return DEFAULT_RING_SIZE
+
+
+def _dedupe_window_s() -> float:
+    try:
+        env = os.environ.get("WAFFLE_FLIGHT_DEDUPE_S", "")
+        return float(env) if env != "" else DEFAULT_DEDUPE_S
+    except ValueError:
+        return DEFAULT_DEDUPE_S
 
 
 def _jsonable(value):
@@ -80,12 +95,18 @@ def _jsonable(value):
 class FlightRecorder:
     """Bounded ring of recent records plus incident assembly/dump."""
 
-    def __init__(self, ring_size: Optional[int] = None) -> None:
+    def __init__(self, ring_size: Optional[int] = None,
+                 dedupe_s: Optional[float] = None) -> None:
         self._ring: "collections.deque[Tuple]" = collections.deque(
             maxlen=ring_size or _ring_size()
         )
         self._lock = threading.Lock()
-        self._seen: set = set()
+        #: (reason, trace_id) -> last fire timestamp; entries older
+        #: than the dedupe window expire, so a RECURRING incident
+        #: re-fires (constructor arg pins the window for tests; None
+        #: re-reads WAFFLE_FLIGHT_DEDUPE_S per trigger)
+        self._seen: Dict[Tuple[str, Optional[str]], float] = {}
+        self._dedupe_s = dedupe_s
         self._seq = 0
         self._incidents: List[Dict] = []
 
@@ -126,12 +147,27 @@ class FlightRecorder:
                 **detail) -> Optional[Dict]:
         """Fire an anomaly trigger: assemble an incident (and dump it to
         ``WAFFLE_FLIGHT_DIR`` when set).  Returns the incident dict, or
-        ``None`` when ``(reason, trace_id)`` already fired (dedupe)."""
+        ``None`` when ``(reason, trace_id)`` fired within the dedupe
+        window (``WAFFLE_FLIGHT_DEDUPE_S``, default 300 s; expired
+        entries re-fire so recurring incidents stay visible)."""
         key = (reason, trace_id)
+        window = (
+            self._dedupe_s if self._dedupe_s is not None
+            else _dedupe_window_s()
+        )
+        now = time.time()
         with self._lock:
-            if key in self._seen:
+            last = self._seen.get(key)
+            if last is not None and window > 0 and now - last < window:
                 return None
-            self._seen.add(key)
+            self._seen[key] = now
+            if len(self._seen) > 4 * MAX_INCIDENTS:
+                # bound the dedupe table: expired entries are dead
+                # weight once their window passed
+                self._seen = {
+                    k: t for k, t in self._seen.items()
+                    if now - t < window
+                }
             self._seq += 1
             seq = self._seq
         incident = self._build_incident(seq, reason, trace_id, detail)

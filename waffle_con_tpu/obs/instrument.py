@@ -18,20 +18,36 @@ and opens a ``dispatch:<op>`` tracer span (category ``dispatch``) so
 host dispatches nest inside the engines' ``search`` spans in the Chrome
 trace.
 
+With profiling on (``WAFFLE_PROFILE=1`` /
+:func:`~waffle_con_tpu.obs.phases.enable_profiling`) every timed
+dispatch additionally opens a :mod:`~waffle_con_tpu.obs.phases`
+record, which the dispatch seam (``ops/jax_scorer.py`` /
+``ops/ragged.py``) fills with the host-prep / device-compute /
+transfer / host-post breakdown and kernel/K/geometry labels.
+
 The proxy is only installed when observability is active (see
 ``construct_backend`` in :mod:`waffle_con_tpu.ops.scorer`); a disabled
 run never pays for it.  It is deliberately transparent to the engines'
 capability feature-tests: attribute access falls through to the wrapped
 backend, so ``getattr(scorer, "run_extend", None)`` is ``None`` exactly
 when the backend lacks the kernel.
+
+:class:`FrontierSampler` is the search-frontier telemetry half: a
+decimated per-pop sampler the engines feed (queue depth, live branch
+count, best-vs-frontier cost gap, speculative commit rate, ragged
+injections) that writes ``frontier`` records into the always-on flight
+ring — ``bench.py --explain`` dumps them as a timeline.
 """
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Dict
+from typing import Dict, Optional
 
+from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import phases as obs_phases
 from waffle_con_tpu.obs import trace as obs_trace
 
 #: dispatch method -> short op label (the same vocabulary as the scorer
@@ -107,11 +123,16 @@ class TimedScorer:
 
         def timed(*args, **kwargs):
             metrics_on = obs_metrics.metrics_enabled()
+            # phase record: the dispatch seam attributes device/
+            # transfer time into it; one boolean check when profiling
+            # is off
+            rec = obs_phases.begin(op, backend)
             with span(f"dispatch:{op}", "dispatch", backend=backend):
                 t0 = time.perf_counter()
                 try:
                     return fn(*args, **kwargs)
                 finally:
+                    obs_phases.end(rec)
                     if metrics_on:
                         dt = time.perf_counter() - t0
                         reg = obs_metrics.registry()
@@ -152,7 +173,101 @@ class TimedScorer:
 
 def maybe_instrument(scorer, backend: str):
     """Wrap ``scorer`` in a :class:`TimedScorer` when observability is
-    active; return it unchanged otherwise."""
-    if obs_metrics.metrics_enabled() or obs_trace.tracing_enabled():
+    active (metrics, tracing, or phase profiling); return it unchanged
+    otherwise."""
+    if (
+        obs_metrics.metrics_enabled()
+        or obs_trace.tracing_enabled()
+        or obs_phases.profiling_enabled()
+    ):
         return TimedScorer(scorer, backend)
     return scorer
+
+
+#: default pop decimation of the frontier sampler; one record per this
+#: many queue pops (``WAFFLE_FRONTIER_SAMPLE`` overrides; 0 disables)
+FRONTIER_SAMPLE_DEFAULT = 64
+
+
+def _frontier_interval() -> int:
+    env = os.environ.get("WAFFLE_FRONTIER_SAMPLE", "")
+    if env == "":
+        return FRONTIER_SAMPLE_DEFAULT
+    try:
+        return max(0, int(env))
+    except ValueError:
+        return FRONTIER_SAMPLE_DEFAULT
+
+
+class FrontierSampler:
+    """Decimated per-pop search-frontier telemetry.
+
+    One per search; the engine pop loops call :meth:`due` every pop (a
+    modulo on an int — the always-on cost) and, when it fires,
+    :meth:`sample` with whatever frontier state is in hand.  Each
+    sample is ONE flight-ring record (kind ``frontier``): pop count,
+    queue depth, live branch count, best-vs-next cost gap, consensus
+    progress, cumulative speculative commit rate, and ragged-injection
+    count — the timeline ``bench.py --explain`` renders, and the
+    context an incident dump carries when a search goes pathological.
+    """
+
+    __slots__ = ("engine", "interval", "_t0", "_n")
+
+    def __init__(self, engine_label: str) -> None:
+        self.engine = engine_label
+        self.interval = _frontier_interval()
+        self._t0 = time.perf_counter()
+        self._n = 0
+
+    def due(self, pops: int) -> bool:
+        return self.interval > 0 and pops % self.interval == 0
+
+    def sample(
+        self,
+        pops: int,
+        queue_depth: int,
+        live_branches: int,
+        top_cost: int,
+        next_cost: Optional[int],
+        top_len: int,
+        farthest: int,
+        counters: Optional[Dict[str, int]] = None,
+    ) -> None:
+        self._n += 1
+        fields = {
+            "engine": self.engine,
+            "t_s": round(time.perf_counter() - self._t0, 4),
+            "pops": int(pops),
+            "queue": int(queue_depth),
+            "live": int(live_branches),
+            "top_cost": int(top_cost),
+            "gap": (
+                int(next_cost) - int(top_cost)
+                if next_cost is not None else None
+            ),
+            "top_len": int(top_len),
+            "farthest": int(farthest),
+        }
+        if counters:
+            spec = (
+                counters.get("run_spec_cols", 0)
+                + counters.get("run_dual_spec_cols", 0)
+            )
+            committed = (
+                counters.get("run_steps", 0)
+                + counters.get("run_dual_steps", 0)
+            )
+            fields["spec_commit_rate"] = (
+                round(committed / spec, 4) if spec else None
+            )
+            fields["ragged_injected"] = counters.get(
+                "run_ragged_injected", 0
+            )
+        obs_flight.record(
+            "frontier", trace_id=obs_trace.current_trace_id(), **fields
+        )
+
+    @property
+    def samples_taken(self) -> int:
+        return self._n

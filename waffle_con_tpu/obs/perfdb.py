@@ -1,0 +1,218 @@
+"""Persistent performance history: an append-only JSONL perf database.
+
+The bench trajectory used to survive only as hand-named
+``BENCH_r0*.json`` snapshots plus a frozen steps/s constant in
+``scripts/ci.sh``; nothing machine-readable connected one round's
+number to the next.  This module is the durable record: every
+``bench.py`` / ``scripts/ci.sh`` / ``scripts/profile_scorer.py`` run
+appends one schema-versioned record, ``scripts/perf_report.py``
+renders the trend, and the CI steps/s gate compares the latest run
+against a **rolling baseline** (median of the recent history) with a
+tolerance band instead of a hardcoded floor (the absolute floor is
+kept as a backstop).
+
+Records are one JSON object per line::
+
+    {"schema": 1, "kind": "microbench", "unix_time": ..., "host": ...,
+     "platform": "cpu", "metric": ..., "value": 1063.2,
+     "unit": "steps/s", "run_cols": 4, "phases": {...}, ...}
+
+``schema`` is the perfdb record major; readers skip records with a
+LARGER major than they understand (forward-written history must not
+brick an older reader) and tolerate unparsable lines (a torn write
+from a killed bench must not poison the database).
+
+The database path is ``WAFFLE_PERFDB`` when set, else
+``evidence/perfdb.jsonl`` under the repository root — inside the repo
+so the history is a retained artifact, not a tmpfile.
+
+This module also owns the **bench evidence schema** contract: every
+JSON line ``bench.py`` prints carries ``"schema":
+EVIDENCE_SCHEMA`` and :func:`load_evidence` validates/rejects unknown
+majors; ``tests/test_evidence_schema.py`` pins the field contract the
+``scripts/ci.sh`` asserts grep for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform as _platform
+import time
+from typing import Dict, List, Optional, Tuple
+
+#: perfdb record major: bump ONLY on a field-meaning change readers
+#: cannot tolerate; additive fields do not bump it
+SCHEMA = 1
+
+#: bench evidence-line major (the ``"schema"`` field on every JSON
+#: line bench.py prints).  2 = the performance-observatory format:
+#: versioned lines, optional ``phases`` breakdown, perfdb appends.
+#: (1 is the retroactive name for the unversioned pre-observatory
+#: lines; a missing ``schema`` field parses as 1.)
+EVIDENCE_SCHEMA = 2
+
+DEFAULT_RELPATH = os.path.join("evidence", "perfdb.jsonl")
+
+#: evidence fields every mode must carry (ci.sh bench smoke asserts
+#: "metric"; the rest are the cross-mode invariants)
+EVIDENCE_REQUIRED = ("metric", "value", "unit", "schema")
+
+#: per-mode required fields — the exact contract scripts/ci.sh's
+#: assert blocks read (tests/test_evidence_schema.py cross-checks this
+#: table against the ci.sh source, so drift fails tier-1)
+EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
+    "serve": (
+        "jobs", "jobs_per_s", "parity", "p50_job_latency_s",
+        "p95_job_latency_s", "serve_stats", "mean_batch_occupancy",
+        "slo", "incidents",
+    ),
+    "serve-mix": (
+        "parity", "ragged_occupancy", "compiles_ragged",
+        "ragged_stats", "bucketed_run_occupancy", "jobs_per_s_ragged",
+    ),
+    "microbench": ("parity", "steps", "stop_code", "breakdown"),
+    "north-star": ("parity", "vs_baseline", "breakdown"),
+}
+
+
+def default_path() -> str:
+    env = os.environ.get("WAFFLE_PERFDB", "")
+    if env:
+        return env
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(root, DEFAULT_RELPATH)
+
+
+def make_record(kind: str, metric: str, value: float, unit: str,
+                **extra) -> Dict:
+    """A schema-stamped perfdb record; ``extra`` fields ride along
+    verbatim (``phases``, ``run_cols``, ``occupancy``, ...)."""
+    rec = {
+        "schema": SCHEMA,
+        "kind": kind,
+        "unix_time": round(time.time(), 3),
+        "host": _platform.node() or "unknown",
+        "machine": _platform.machine() or "unknown",
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+    }
+    rec.update(extra)
+    return rec
+
+
+def append_record(record: Dict, path: Optional[str] = None) -> str:
+    """Append one record (newline-delimited JSON) to the database,
+    creating the parent directory on first write; returns the path."""
+    if int(record.get("schema", 0)) != SCHEMA:
+        raise ValueError(
+            f"refusing to write schema {record.get('schema')!r} "
+            f"record (writer is schema {SCHEMA})"
+        )
+    path = path or default_path()
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record, sort_keys=True) + "\n")
+    return path
+
+
+def load_records(path: Optional[str] = None,
+                 kind: Optional[str] = None) -> List[Dict]:
+    """Parse the database, oldest first.  Unparsable lines are skipped
+    (torn writes); records with a NEWER major than :data:`SCHEMA` are
+    skipped too (never guess at a future format).  ``kind`` filters to
+    one record kind."""
+    path = path or default_path()
+    out: List[Dict] = []
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        try:
+            major = int(rec.get("schema", 0))
+        except (TypeError, ValueError):
+            continue
+        if major > SCHEMA or major < 1:
+            continue
+        if kind is not None and rec.get("kind") != kind:
+            continue
+        out.append(rec)
+    return out
+
+
+def rolling_baseline(records: List[Dict], metric: Optional[str] = None,
+                     window: int = 10) -> Optional[float]:
+    """Median ``value`` of the last ``window`` records (optionally
+    filtered to one metric name) — the CI gate's baseline.  ``None``
+    when there is no usable history."""
+    values = [
+        float(r["value"]) for r in records
+        if isinstance(r.get("value"), (int, float))
+        and (metric is None or r.get("metric") == metric)
+    ][-window:]
+    if not values:
+        return None
+    values.sort()
+    n = len(values)
+    mid = n // 2
+    return values[mid] if n % 2 else (values[mid - 1] + values[mid]) / 2
+
+
+# -- bench evidence schema --------------------------------------------
+
+
+def stamp_evidence(out: Dict) -> Dict:
+    """Stamp a bench evidence line with the current schema major
+    (bench.py calls this on every line it prints)."""
+    out["schema"] = EVIDENCE_SCHEMA
+    return out
+
+
+def load_evidence(line_or_dict) -> Dict:
+    """Parse and validate one bench evidence line.
+
+    Raises ``ValueError`` for: unparsable JSON, an unknown (newer)
+    schema major, or a line missing the cross-mode required fields.
+    A missing ``schema`` field parses as major 1 (the pre-observatory
+    format) and skips the field checks newer majors guarantee."""
+    if isinstance(line_or_dict, str):
+        evidence = json.loads(line_or_dict)
+    else:
+        evidence = dict(line_or_dict)
+    if not isinstance(evidence, dict):
+        raise ValueError("evidence line is not a JSON object")
+    major = int(evidence.get("schema", 1))
+    if major > EVIDENCE_SCHEMA:
+        raise ValueError(
+            f"evidence schema {major} is newer than this reader "
+            f"(max {EVIDENCE_SCHEMA}); refusing to guess"
+        )
+    if major < 1:
+        raise ValueError(f"nonsense evidence schema {major}")
+    if major >= 2:
+        missing = [k for k in EVIDENCE_REQUIRED if k not in evidence]
+        if missing:
+            raise ValueError(f"evidence line missing {missing}")
+        mode = evidence.get("mode")
+        for key in EVIDENCE_MODE_FIELDS.get(mode, ()):
+            if key not in evidence:
+                raise ValueError(
+                    f"mode {mode!r} evidence missing {key!r}"
+                )
+    return evidence

@@ -114,11 +114,15 @@ def run_reported_search(engine, engine_label: str, impl: Callable):
     # lazy submodule imports keep obs.report importable mid-package-init
     from waffle_con_tpu.obs import flight as obs_flight
     from waffle_con_tpu.obs import metrics as obs_metrics
+    from waffle_con_tpu.obs import phases as obs_phases
     from waffle_con_tpu.obs import slo as obs_slo
     from waffle_con_tpu.obs import trace as obs_trace
 
     tracer = obs_trace.get_tracer()
     totals_before = tracer.category_totals() if tracer.enabled else None
+    phases_before = (
+        obs_phases.totals() if obs_phases.profiling_enabled() else None
+    )
     t0 = time.perf_counter()
     with tracer.span("search", "search", engine=engine_label):
         results = impl()
@@ -151,6 +155,15 @@ def run_reported_search(engine, engine_label: str, impl: Callable):
     trace_id = obs_trace.current_trace_id()
     if trace_id is not None:
         report.extra["trace_id"] = trace_id
+    if phases_before is not None:
+        # per-phase dispatch time spent DURING this search (process-
+        # wide totals diffed around it, same shape as time_breakdown)
+        deltas = {
+            p: round(total - phases_before.get(p, 0.0), 6)
+            for p, total in obs_phases.totals().items()
+        }
+        if any(v > 0.0 for v in deltas.values()):
+            report.extra["phases"] = deltas
     # rolling-SLO check BEFORE this sample joins the window (a
     # pathological search must not dilute the baseline it is judged
     # against); fires the flight recorder's slow_search trigger

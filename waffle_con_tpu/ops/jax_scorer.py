@@ -63,6 +63,7 @@ import numpy as np
 from jax import lax
 
 from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.obs import phases as _phases
 from waffle_con_tpu.obs.trace import span as _obs_span
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
@@ -3162,6 +3163,12 @@ class JaxScorer(WavefrontScorer):
         off0 = int(offs[0])
         return bool((offs == off0).all()), off0
 
+    def _geom_bucket(self) -> str:
+        """Geometry label for phase profiling: band count x reads x
+        band width — coarse enough to bucket, fine enough to separate
+        the north-star geometry from small fixtures."""
+        return f"B{self._B}R{self._R}W{self._W}"
+
     def ragged_run_probe(self, h: int):
         """Duck-typed hop for the serve layer's ragged dispatch: return
         ``(self, handle)`` when this scorer can in principle join a
@@ -3224,6 +3231,12 @@ class JaxScorer(WavefrontScorer):
                     f"{len(consensus)}"
                 )
             self._invalidate_root_stats()
+            rec = _phases.current()
+            if rec is not None:
+                # device work already happened inside the ragged gang's
+                # own record; consuming the deposit is pure host time
+                rec.annotate(kernel="ragged", k=1,
+                             geom=self._geom_bucket())
             steps, code = inj.steps, inj.code
             self.counters["run_calls"] += 1
             self.counters["run_steps"] += steps
@@ -3243,6 +3256,7 @@ class JaxScorer(WavefrontScorer):
                 self._grow_e()  # band now mismatches the pool: solo next
             return steps, code, appended, self._stats_np(inj.stats), []
         self._invalidate_root_stats()
+        rec = _phases.current()
         slot = self._slot_of[h]
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
@@ -3275,12 +3289,15 @@ class JaxScorer(WavefrontScorer):
             _note_compile(
                 "j_run_pallas", (self._B, self._R, self._W, MS, i16)
             )
-            out = self._pallas_guarded(
-                1, MS, _j_run_pallas,
-                self._state, self._reads_T(), self._rlen, params,
-                self._wc, self._et, self._A, self.num_symbols, MS, i16,
-                self._pallas_mode == "interpret",
-            )
+            with _phases.device_scope(rec):
+                # _pallas_guarded block_until_readys internally, so the
+                # scope's elapsed time is real kernel time
+                out = self._pallas_guarded(
+                    1, MS, _j_run_pallas,
+                    self._state, self._reads_T(), self._rlen, params,
+                    self._wc, self._et, self._A, self.num_symbols, MS,
+                    i16, self._pallas_mode == "interpret",
+                )
             if out is None:
                 use_pallas = False
             else:
@@ -3293,15 +3310,29 @@ class JaxScorer(WavefrontScorer):
                 self._B, self._R, self._W, self._C, self._L, self._A,
                 uniform, self.num_symbols, self._xla_i16(), cols,
             ))
+            with _phases.device_scope(rec):
+                out_dev = _j_run(
+                    self._state, self._reads, self._reads_pad,
+                    self._rlen, params, self._wc, self._et, self._A,
+                    uniform, a_real=self.num_symbols,
+                    i16=self._xla_i16(), cols=cols,
+                )
+                if rec is not None:
+                    # profiling fences the async dispatch so device
+                    # time separates from the device_get below; an
+                    # unprofiled run never blocks early
+                    out_dev = jax.block_until_ready(out_dev)
             (state, steps, code, stats, cons_row, fin_eds, fin_ovf,
-             rec_count, rec_steps, rec_fins, iters) = _j_run(
-                self._state, self._reads, self._reads_pad, self._rlen,
-                params, self._wc, self._et, self._A, uniform,
-                a_real=self.num_symbols, i16=self._xla_i16(), cols=cols,
+             rec_count, rec_steps, rec_fins, iters) = out_dev
+        if rec is not None:
+            rec.annotate(
+                kernel="pallas" if use_pallas else "solo",
+                k=int(cols), geom=self._geom_bucket(),
             )
         self._state = state
         defer = deferred_sync_enabled()
-        with _obs_span("device_get:run_extend", "device-sync"):
+        with _obs_span("device_get:run_extend", "device-sync"), \
+                _phases.transfer_scope(rec):
             # async dispatch seam: only the CONTROL results the engine's
             # bookkeeping needs right now cross the device boundary here;
             # the bulk observation arrays ride a DeferredStats and are
@@ -3391,6 +3422,7 @@ class JaxScorer(WavefrontScorer):
         constant ``min_count`` / ``imb_min`` tables (the ``min_af == 0``
         semantics)."""
         self._invalidate_root_stats()
+        rec = _phases.current()
         s1 = self._slot_of[h1]
         s2 = self._slot_of[h2]
         need = max(len(consensus1), len(consensus2)) + max_steps + 2
@@ -3445,14 +3477,15 @@ class JaxScorer(WavefrontScorer):
             _note_compile(
                 "j_run_dual_pallas", (self._B, self._R, self._W, MS, i16)
             )
-            out = self._pallas_guarded(
-                2, MS, _j_run_dual_pallas,
-                self._state, self._reads_T(), self._rlen, params,
-                np.ascontiguousarray(mc_tab, dtype=np.int32),
-                imb_tab, self._wc, self._et, self._A,
-                self.num_symbols, MS, i16,
-                self._pallas_mode == "interpret",
-            )
+            with _phases.device_scope(rec):
+                out = self._pallas_guarded(
+                    2, MS, _j_run_dual_pallas,
+                    self._state, self._reads_T(), self._rlen, params,
+                    np.ascontiguousarray(mc_tab, dtype=np.int32),
+                    imb_tab, self._wc, self._et, self._A,
+                    self.num_symbols, MS, i16,
+                    self._pallas_mode == "interpret",
+                )
             if out is None:
                 use_pallas = False
             else:
@@ -3466,17 +3499,31 @@ class JaxScorer(WavefrontScorer):
                 self._B, self._R, self._W, self._C, self._L, self._A,
                 uni1 and uni2, self.num_symbols, self._xla_i16(), cols,
             ))
+            with _phases.device_scope(rec):
+                out_dev = _j_run_dual(
+                    self._state, self._reads, self._reads_pad,
+                    self._rlen, params,
+                    np.ascontiguousarray(mc_tab, dtype=np.int32),
+                    imb_tab, self._wc, self._et, self._A, uni1 and uni2,
+                    a_real=self.num_symbols, i16=self._xla_i16(),
+                    cols=cols,
+                )
+                if rec is not None:
+                    # profiling fences the async dispatch (see
+                    # run_extend)
+                    out_dev = jax.block_until_ready(out_dev)
             (state, steps, code, stats1, stats2, act1, act2, consa,
              consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
-             rec_a2, iters) = _j_run_dual(
-                self._state, self._reads, self._reads_pad, self._rlen,
-                params, np.ascontiguousarray(mc_tab, dtype=np.int32),
-                imb_tab, self._wc, self._et, self._A, uni1 and uni2,
-                a_real=self.num_symbols, i16=self._xla_i16(), cols=cols,
+             rec_a2, iters) = out_dev
+        if rec is not None:
+            rec.annotate(
+                kernel="pallas" if use_pallas else "dual",
+                k=int(cols), geom=self._geom_bucket(),
             )
         self._state = state
         defer = deferred_sync_enabled()
-        with _obs_span("device_get:run_extend_dual", "device-sync"):
+        with _obs_span("device_get:run_extend_dual", "device-sync"), \
+                _phases.transfer_scope(rec):
             # async dispatch seam (see run_extend): control results now,
             # per-side observation arrays deferred.  The act masks are
             # control — the host act mirror must update before the next
@@ -3630,6 +3677,7 @@ class JaxScorer(WavefrontScorer):
         length at the split + 1), and fresh registered handles ``h1`` /
         ``h2`` (``h2`` None for singles)."""
         self._invalidate_root_stats()
+        rec = _phases.current()
         K = self.ARENA_K
         n_live = len(node_specs)
         if not 1 <= n_live <= K:
@@ -3717,10 +3765,8 @@ class JaxScorer(WavefrontScorer):
             self._B, self._R, self._W, self._C, self._A, K, uniform,
             self.num_symbols, cols,
         ))
-        (state, hist, nsteps, code, stop_node, steps, stats, act, cons,
-         clen, alive, cre_count, cre_parent, cre_kind, cre_sym1,
-         cre_sym2, cre_len, stop_diag, iters) = (
-            _j_arena(
+        with _phases.device_scope(rec):
+            out_dev = _j_arena(
                 self._state,
                 self._reads,
                 self._reads_pad,
@@ -3744,9 +3790,19 @@ class JaxScorer(WavefrontScorer):
                 a_real=self.num_symbols,
                 cols=cols,
             )
-        )
+            if rec is not None:
+                # profiling fences the async dispatch (see run_extend)
+                out_dev = jax.block_until_ready(out_dev)
+        (state, hist, nsteps, code, stop_node, steps, stats, act, cons,
+         clen, alive, cre_count, cre_parent, cre_kind, cre_sym1,
+         cre_sym2, cre_len, stop_diag, iters) = out_dev
+        if rec is not None:
+            rec.annotate(
+                kernel="arena", k=int(cols), geom=self._geom_bucket()
+            )
         self._state = state
-        with _obs_span("device_get:run_arena", "device-sync"):
+        with _obs_span("device_get:run_arena", "device-sync"), \
+                _phases.transfer_scope(rec):
             (hist_np, nsteps, code, stop_node, steps_np, stats_np, act_np,
              cons_np, alive_np, cre_count, stop_diag,
              iters) = jax.device_get(
@@ -3766,10 +3822,11 @@ class JaxScorer(WavefrontScorer):
             key1 = f"arena_s1_nc{diag // 64}_f{diag % 64:02d}"
             self.counters[key1] = self.counters.get(key1, 0) + 1
         if cre_count:
-            (cre_parent_np, cre_kind_np, cre_sym1_np, cre_sym2_np,
-             cre_len_np) = jax.device_get(
-                (cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len)
-            )
+            with _phases.transfer_scope(rec):
+                (cre_parent_np, cre_kind_np, cre_sym1_np, cre_sym2_np,
+                 cre_len_np) = jax.device_get(
+                    (cre_parent, cre_kind, cre_sym1, cre_sym2, cre_len)
+                )
 
         # decode the typed event stream
         events = []

@@ -71,6 +71,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import phases as _phases
 
 logger = logging.getLogger(__name__)
 
@@ -721,6 +722,9 @@ class BandArena:
         deposited injection keys (the dispatcher discards leftovers
         after the batch).  Never raises: any failure degrades the
         affected members to the solo path."""
+        # gang steps happen on the dispatcher thread, outside any
+        # TimedScorer dispatch, so they open their own phase record
+        rec = _phases.begin("ragged_group", "jax")
         try:
             return self._run_group(specs)
         except Exception:  # noqa: BLE001 - ragged must never fail a job
@@ -729,6 +733,8 @@ class BandArena:
                 len(specs), exc_info=True,
             )
             return []
+        finally:
+            _phases.end(rec)
 
     def _run_group(self, specs: List[RunSpec]) -> List[Tuple[int, int]]:
         import jax
@@ -758,14 +764,21 @@ class BandArena:
             P *= 2
         P = min(P, self.rows)
 
+        rec = _phases.current()
+        if rec is not None:
+            rec.annotate(
+                kernel="ragged", k=1, geom=f"P{P}W{self.W}G{self.gang}"
+            )
+
         # one device_get per member: its slot's full band-state rows
         loaded = []
-        for spec, rows, slot in members:
-            st = spec.scorer._state
-            loaded.append(jax.device_get((
-                st["D"][slot], st["e"][slot], st["rmin"][slot],
-                st["er"][slot], st["cons"][slot], st["clen"][slot],
-            )))
+        with _phases.transfer_scope(rec):
+            for spec, rows, slot in members:
+                st = spec.scorer._state
+                loaded.append(jax.device_get((
+                    st["D"][slot], st["e"][slot], st["rmin"][slot],
+                    st["er"][slot], st["cons"][slot], st["clen"][slot],
+                )))
 
         D = np.full((P, self.W), int(js.INF), np.int32)
         e = np.zeros(P, np.int32)
@@ -823,10 +836,17 @@ class BandArena:
         js._note_compile(
             "j_run_ragged", (P, self.W, self.L, self.C, G1, self.A)
         )
-        out = jax.device_get(self._kernel(
-            self._reads[:P], self._rlen[:P], D, e, rmin, er, off, act,
-            seg, cons, clen, jp, A=self.A,
-        ))
+        with _phases.device_scope(rec):
+            out_dev = self._kernel(
+                self._reads[:P], self._rlen[:P], D, e, rmin, er, off,
+                act, seg, cons, clen, jp, A=self.A,
+            )
+            if rec is not None:
+                # profiling fences the async dispatch so the device_get
+                # below measures pure transfer
+                out_dev = jax.block_until_ready(out_dev)
+        with _phases.transfer_scope(rec):
+            out = jax.device_get(out_dev)
         (oD, oe, ormin, oer, ocons, oclen, osteps, ocode, oiters,
          oeds, oocc, osplit, oreached, ofin, ofovf) = out
 

@@ -95,20 +95,31 @@ class DeferredStats(BranchStats):
       (``isinstance`` included) and resolves transparently.
     """
 
-    __slots__ = ("_fetch", "_value", "_t0")
+    __slots__ = ("_fetch", "_value", "_t0", "_phase_rec")
 
     def __init__(self, fetch) -> None:
         # no super().__init__: the parent's slot storage stays unused and
         # every field access routes through the properties below
         self._fetch = fetch
         self._value: Optional[BranchStats] = None
+        ph = _phases_mod()
+        # the originating dispatch's phase record, so the eventual fetch
+        # is attributed to IT as transfer time (possibly "late", after
+        # the dispatch returned) — None whenever profiling is off
+        self._phase_rec = ph.current() if ph.profiling_enabled() else None
         self._t0 = time.perf_counter()
 
     def resolve(self) -> BranchStats:
         """Force the device fetch; idempotent."""
         if self._value is None:
             _note_overlap(time.perf_counter() - self._t0)
-            self._value = self._fetch()
+            rec, self._phase_rec = self._phase_rec, None
+            if rec is not None:
+                t0 = time.perf_counter()
+                self._value = self._fetch()
+                rec.add_transfer(time.perf_counter() - t0, t0)
+            else:
+                self._value = self._fetch()
             self._fetch = None
         return self._value
 
@@ -129,6 +140,21 @@ class DeferredStats(BranchStats):
     reached = _get("reached")
     fin = _get("fin")
     del _get
+
+
+#: lazily bound ``waffle_con_tpu.obs.phases`` module — a module-top
+#: import would cycle (obs.report imports this module); the cached ref
+#: keeps the per-DeferredStats cost at one global lookup
+_PHASES = None
+
+
+def _phases_mod():
+    global _PHASES
+    if _PHASES is None:
+        from waffle_con_tpu.obs import phases
+
+        _PHASES = phases
+    return _PHASES
 
 
 #: process-wide overlap accounting: seconds of host work that ran while
