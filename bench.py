@@ -988,6 +988,235 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
     }
 
 
+def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
+    """Scale-out storm harness (``--storm N``): a heavy-tailed, bursty
+    job mix fired at the replicated front door.
+
+    The mix draws read counts and lengths from seeded Pareto tails (like
+    ``--serve-mix``), salts in mesh-large jobs that the placement policy
+    promotes onto the sharded scorer, and spreads priorities over three
+    classes.  Arrivals follow a Poisson burst process: exponentially
+    spaced bursts of geometrically distributed size, so admission sees
+    genuine queueing, not a smooth drip.
+
+    Two timed phases run the SAME mix on the SAME arrival schedule —
+    one replica, then ``replicas`` replicas — each preceded by an
+    untimed warmup pass that absorbs XLA compiles, and each timed
+    twice with the faster wall kept (noise-robust on shared CI
+    hosts; fault-armed phases time once).  Reports jobs/s for
+    both, the multi/single speedup, p50/p95/p99 job latency, a
+    per-replica occupancy/routing table, and a parity bit over every
+    completed job (both phases) against serial references.
+
+    ``supervised=True`` routes served jobs through the fault-tolerant
+    supervisor (serial references stay unsupervised), which is where
+    ``WAFFLE_FAULTS`` injection applies — the CI shedding demo demotes
+    one replica's backend mid-storm and the front door reroutes.  The
+    plan is armed for the TIMED multi-replica pass only (a bounded
+    firing count would otherwise be consumed by the warmups and the
+    single-replica baseline)."""
+    import numpy as np
+
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.ops import ragged as ops_ragged
+    from waffle_con_tpu.ops.jax_scorer import compile_count
+    from waffle_con_tpu.serve import (
+        JobRequest,
+        PlacementPolicy,
+        ReplicatedConfig,
+        ReplicatedService,
+        ServeConfig,
+    )
+    from waffle_con_tpu.runtime import faults as runtime_faults
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    fault_spec = ""
+    if supervised and os.environ.get("WAFFLE_FAULTS"):
+        # defuse the env plan now; re-armed just before the timed
+        # multi-replica pass (see docstring)
+        fault_spec = os.environ.pop("WAFFLE_FAULTS")
+        runtime_faults.install(None)
+
+    rng = np.random.default_rng(20260805)
+    large_threshold = 16
+    shapes, priorities = [], []
+    for i in range(num_jobs):
+        if i % 5 == 3:  # mesh-large: promoted by the placement policy
+            n_reads, seq_len = 24, 120
+        else:
+            n_reads = int(min(12, 3 + rng.pareto(1.5) * 2))
+            seq_len = int(min(360, 100 + rng.pareto(1.5) * 60))
+        shapes.append((n_reads, seq_len))
+        priorities.append(int(rng.choice([0, 1, 2], p=[0.5, 0.3, 0.2])))
+
+    def build_cfg(n_reads, seq_len, sup):
+        builder = (
+            CdwfaConfigBuilder()
+            .min_count(max(2, n_reads // 4))
+            .backend("jax")
+            .initial_band(_band_seed(seq_len, error_rate))
+        )
+        if sup:
+            builder = (
+                builder.supervised(True)
+                .dispatch_retries(1)
+                .retry_backoff_s(0.0)
+                .breaker_threshold(2)
+            )
+        return builder.build()
+
+    jobs = []
+    for i, (n_reads, seq_len) in enumerate(shapes):
+        reads = tuple(
+            generate_test(4, seq_len, n_reads, error_rate, seed=2000 + i)[1]
+        )
+        jobs.append(
+            (reads, build_cfg(n_reads, seq_len, False),
+             build_cfg(n_reads, seq_len, supervised))
+        )
+
+    # serial references double as the base-compile warmup; the mesh
+    # variants compile during each phase's untimed warmup pass
+    serial = [
+        _make_engine("single", base_cfg, reads).consensus()
+        for reads, base_cfg, _serve_cfg in jobs
+    ]
+
+    # Poisson bursts: exponential inter-burst gaps, geometric burst sizes
+    offsets, t, i = [], 0.0, 0
+    while i < num_jobs:
+        burst = int(rng.geometric(0.45))
+        for _ in range(min(burst, num_jobs - i)):
+            offsets.append(t)
+            i += 1
+        t += float(rng.exponential(0.004))
+    arrival_span = offsets[-1] if offsets else 0.0
+
+    policy = PlacementPolicy(large_read_threshold=large_threshold,
+                             mesh_shards=2)
+    base = ServeConfig(
+        workers=min(num_jobs, 4),
+        queue_limit=max(8, 2 * num_jobs),
+        batch_window_s=0.005,
+        max_batch=8,
+        placement=policy,
+    )
+
+    def run_phase(n_replicas, arm=None):
+        """One untimed warmup pass (absorbs XLA compiles), then timed
+        passes.  Paired second-scale walls on a shared host are
+        noise-fragile, so an unfaulted phase times TWO passes and keeps
+        the faster (min-wall is the noise-robust throughput estimator);
+        a fault-armed phase times exactly ONE pass — its bounded firing
+        counts must land in a single measured storm.  Every pass's
+        results are parity-checked, not just the kept one."""
+        ops_ragged.reset_arena()
+        timed_passes = 1 if arm is not None else 2
+        best, parity_ok = None, True
+        for _attempt in range(1 + timed_passes):
+            if _attempt == 1 and arm is not None:
+                arm()
+            svc = ReplicatedService(
+                ReplicatedConfig(replicas=n_replicas, base=base)
+            )
+            reqs = [
+                JobRequest(kind="single", reads=reads, config=serve_cfg,
+                           priority=prio)
+                for (reads, _base_cfg, serve_cfg), prio
+                in zip(jobs, priorities)
+            ]
+            t0 = time.perf_counter()
+            handles = []
+            for off, req in zip(offsets, reqs):
+                lag = off - (time.perf_counter() - t0)
+                if lag > 0:
+                    time.sleep(lag)
+                handles.append(svc.submit(req))
+            results = [h.result() for h in handles]
+            wall = time.perf_counter() - t0
+            lats = sorted(h.latency_s for h in handles)
+            stats = svc.stats()
+            rep_stats = svc.replica_stats()
+            svc.close()
+            parity_ok = parity_ok and all(
+                r == ref for r, ref in zip(results, serial)
+            )
+            if _attempt == 0:
+                continue
+            if best is None or wall < best[0]:
+                best = (wall, stats, rep_stats, lats)
+        return best + (parity_ok,)
+
+    s_wall, _s_stats, _s_reps, _s_lat, s_parity = run_phase(1)
+    arm = None
+    if fault_spec:
+        arm = lambda: runtime_faults.install(  # noqa: E731
+            runtime_faults.plan_from_env(fault_spec)
+        )
+    m_wall, m_stats, m_reps, m_lat, m_parity = run_phase(
+        replicas, arm=arm
+    )
+    if fault_spec:
+        os.environ["WAFFLE_FAULTS"] = fault_spec
+
+    parity = s_parity and m_parity
+    p50 = m_lat[len(m_lat) // 2]
+    p95 = m_lat[min(len(m_lat) - 1, int(len(m_lat) * 0.95))]
+    p99 = m_lat[min(len(m_lat) - 1, int(len(m_lat) * 0.99))]
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.obs import slo as obs_slo
+
+    out = {
+        "metric": f"storm_{num_jobs}jobs_{replicas}r_jobs_per_s",
+        "value": round(num_jobs / m_wall, 4),
+        "unit": "jobs/s",
+        "mode": "storm",
+        "jobs": num_jobs,
+        "replicas": replicas,
+        "shapes": shapes,
+        "priorities": priorities,
+        "large_jobs": sum(
+            1 for n, _ in shapes if n >= large_threshold
+        ),
+        "mesh_placed": m_stats["jobs"].get("mesh_placed", 0),
+        "jobs_per_s": round(num_jobs / m_wall, 4),
+        "jobs_per_s_single": round(num_jobs / s_wall, 4),
+        "speedup_vs_single": round(s_wall / m_wall, 4),
+        "wall_s": round(m_wall, 4),
+        "arrival_span_s": round(arrival_span, 4),
+        "p50_job_latency_s": round(p50, 4),
+        "p95_job_latency_s": round(p95, 4),
+        "p99_job_latency_s": round(p99, 4),
+        "parity": parity,
+        "aged_pops": m_stats.get("aged_pops", 0),
+        "per_replica": [
+            {k: rep.get(k) for k in
+             ("replica", "state", "routed", "demotions", "sheds",
+              "readmits", "mean_batch_occupancy",
+              "ragged_mean_occupancy", "devices")}
+            for rep in m_reps
+        ],
+        "shed": {
+            "demotions": sum(r.get("demotions", 0) for r in m_reps),
+            "sheds": sum(r.get("sheds", 0) for r in m_reps),
+            "readmits": sum(r.get("readmits", 0) for r in m_reps),
+        },
+        "compile_total": compile_count(),
+        "slo": obs_slo.snapshot(),
+        "incidents": [
+            {k: inc.get(k) for k in
+             ("seq", "reason", "trace_id", "unix_time", "path")}
+            for inc in obs_flight.incidents()
+        ],
+        "runtime_events": _runtime_events(),
+    }
+    if supervised:
+        out["supervised"] = True
+    if fault_spec:
+        out["faults"] = fault_spec
+    return out
+
+
 def bench_explain(num_reads, seq_len, error_rate):
     """Bottleneck explainer (``--explain``): ONE profiled single-engine
     search with dense frontier sampling, rendered as a human-readable
@@ -1390,6 +1619,19 @@ def main() -> None:
         "all-jobs parity bit",
     )
     parser.add_argument(
+        "--storm", type=int, default=None, metavar="N",
+        help="scale-out storm harness: N jobs with heavy-tailed sizes, "
+        "three priority classes, mesh-large jobs and Poisson-burst "
+        "arrivals, fired at the replicated front door; reports jobs/s "
+        "vs a single-replica baseline on the same schedule, "
+        "p50/p95/p99 job latency, a per-replica table, and an "
+        "all-jobs parity bit",
+    )
+    parser.add_argument(
+        "--replicas", type=int, default=2, metavar="R",
+        help="with --storm: replica count for the multi-replica phase",
+    )
+    parser.add_argument(
         "--serve-supervised", action="store_true",
         help="with --serve: run the served jobs under the fault-"
         "tolerant supervisor (warmup stays unsupervised), so "
@@ -1430,9 +1672,19 @@ def main() -> None:
         # and subprocess children inherit it
         os.environ["WAFFLE_PROFILE"] = "1"
 
+    if args.storm:
+        # replicas pin to disjoint CPU device slices: make sure the host
+        # platform exposes several virtual devices BEFORE jax loads
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     if args.platform == "cpu" and (
         args._run or args._gate or args.grid or args.dual or args.priority
-        or args.serve or args.serve_mix or args.microbench or args.explain
+        or args.serve or args.serve_mix or args.storm or args.microbench
+        or args.explain
     ):
         _force_cpu_backend()
 
@@ -1499,6 +1751,21 @@ def main() -> None:
         out = bench_serve_mix(args.serve_mix)
         out["device_platform"] = _current_platform()
         _emit(out, perfdb_kind="serve-mix")
+        return
+
+    if args.storm:
+        from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        out = bench_storm(
+            args.storm,
+            replicas=args.replicas,
+            supervised=args.serve_supervised,
+        )
+        out["device_platform"] = _current_platform()
+        # fault-injected (shedding-demo) runs measure degraded-mode
+        # behaviour — never let them into the rolling perf baseline
+        _emit(out, perfdb_kind=None if out.get("faults") else "storm")
         return
 
     if args._run:

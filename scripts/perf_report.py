@@ -18,6 +18,17 @@ JSONL of schema-versioned records written by ``bench.py`` and
   breach.  With no prior history the baseline check is vacuous (first
   run seeds the database) but the floor still applies.
 
+* ``--check --kinds a,b,c``: gate several kinds in one run (ci.sh
+  gates ``microbench,serve-mix,storm`` this way).  Every kind uses the
+  same tolerance against its own same-platform, same-metric rolling
+  baseline; the absolute ``--floor`` backstop applies to the
+  ``microbench`` kind only (serving jobs/s have no equivalent
+  constant — their floors live in the ci.sh smoke asserts).
+
+Baselines are platform- AND metric-scoped: a cpu run never gates
+against device history, and a ``--storm 16`` record never becomes the
+baseline for the ci ``--storm 8`` geometry.
+
 Values are throughput-style (higher is better) for every current
 record kind; the gate compares one-sided accordingly.
 """
@@ -61,28 +72,33 @@ def render_trend(records, limit):
               f"{_fmt(latest):>9s} {vs:>8s}")
 
 
-def check(records, args):
+def check(records, args, kind=None, floor=None):
+    kind = kind if kind is not None else args.kind
+    floor = floor if floor is not None else args.floor
     recs = [r for r in records
             if isinstance(r.get("value"), (int, float))
             and (args.metric is None or r.get("metric") == args.metric)]
     if not recs:
-        print(f"perfdb check: no {args.kind!r} records in "
-              f"{args.db} — nothing to gate (first run seeds the db)")
+        print(f"perfdb check: no {kind!r} records in "
+              f"{args.db or perfdb.default_path()} — nothing to gate "
+              f"(first run seeds the db)")
         return 0
     latest = recs[-1]
     value = float(latest["value"])
-    # judge against same-platform history only: a cpu run gated
-    # against device steps/s (or vice versa) is always wrong
+    # judge against same-platform, same-metric history only: a cpu run
+    # gated against device steps/s — or a --storm 8 run gated against
+    # --storm 16 throughput — is always wrong
     prior = [r for r in recs[:-1]
-             if r.get("platform") == latest.get("platform")]
+             if r.get("platform") == latest.get("platform")
+             and r.get("metric") == latest.get("metric")]
     base = perfdb.rolling_baseline(prior, window=args.window)
     unit = latest.get("unit", "")
     where = (f"{latest.get('kind')}/{latest.get('metric')} on "
              f"{latest.get('platform', '?')}")
     ok = True
-    if value < args.floor:
+    if value < floor:
         print(f"perfdb check FAIL: {where} latest {value} {unit} < "
-              f"absolute floor {args.floor}")
+              f"absolute floor {floor}")
         ok = False
     if base is not None:
         allowed = base * (1.0 - args.tolerance)
@@ -95,7 +111,7 @@ def check(records, args):
         ok = ok and value >= allowed
     else:
         print(f"perfdb check ok: {where} latest {_fmt(value)} {unit}, "
-              f"no prior history (floor {args.floor} passed)")
+              f"no prior history (floor {floor} passed)")
     return 0 if ok else 1
 
 
@@ -109,6 +125,10 @@ def main():
     parser.add_argument("--kind", default=None,
                         help="filter to one record kind "
                         "(--check defaults to 'microbench')")
+    parser.add_argument("--kinds", default=None,
+                        help="with --check: comma-separated kinds to "
+                        "gate in one run; the absolute --floor backstop "
+                        "applies to 'microbench' only")
     parser.add_argument("--metric", default=None,
                         help="filter to one metric name")
     parser.add_argument("--limit", type=int, default=20,
@@ -128,14 +148,21 @@ def main():
         "or 900, matching ci.sh's --assert-steps-floor)",
     )
     args = parser.parse_args()
-    if args.check and args.kind is None:
-        args.kind = "microbench"
+    if args.check:
+        if args.kinds:
+            kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+        else:
+            kinds = [args.kind or "microbench"]
+        rc = 0
+        for kind in kinds:
+            records = perfdb.load_records(args.db, kind=kind)
+            floor = args.floor if kind == "microbench" else 0.0
+            rc = max(rc, check(records, args, kind=kind, floor=floor))
+        sys.exit(rc)
 
     records = perfdb.load_records(args.db, kind=args.kind)
-    if args.metric is not None and not args.check:
+    if args.metric is not None:
         records = [r for r in records if r.get("metric") == args.metric]
-    if args.check:
-        sys.exit(check(records, args))
     render_trend(records, args.limit)
 
 

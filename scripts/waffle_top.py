@@ -85,13 +85,38 @@ def render(payload: dict, plain: bool = False) -> str:
         f"queue_depth={stats.get('queue_depth', 0)}"
     )
     dispatch = stats.get("dispatch", {})
-    lines.append(
-        f"dispatch: batches={dispatch.get('batches', 0)} "
-        f"coalesced={dispatch.get('coalesced_batches', 0)} "
-        f"direct={dispatch.get('direct_dispatches', 0)} "
-        f"mean_occupancy={dispatch.get('mean_batch_occupancy', 0):.2f} "
-        f"max_occupancy={dispatch.get('occupancy_max', 0)}"
-    )
+    if dispatch:
+        lines.append(
+            f"dispatch: batches={dispatch.get('batches', 0)} "
+            f"coalesced={dispatch.get('coalesced_batches', 0)} "
+            f"direct={dispatch.get('direct_dispatches', 0)} "
+            f"mean_occupancy={dispatch.get('mean_batch_occupancy', 0):.2f} "
+            f"max_occupancy={dispatch.get('occupancy_max', 0)}"
+        )
+
+    replicas = payload.get("replicas") or stats.get("replicas")
+    if replicas:
+        lines.append(f"{bold}replicas{reset} ({len(replicas)})")
+        lines.append(
+            f"  {'replica':<16} {'state':<9} {'outst':>5} {'queue':>5} "
+            f"{'routed':>6} {'done':>6} {'demote':>6} {'shed':>4} "
+            f"{'occ':>5} {'hold':>8}"
+        )
+        for rep in replicas:
+            jobs_r = rep.get("jobs", {})
+            hold = rep.get("last_hold_ms")
+            lines.append(
+                f"  {str(rep.get('replica', '?'))[:16]:<16} "
+                f"{str(rep.get('state', '?')):<9} "
+                f"{rep.get('outstanding', 0):>5} "
+                f"{rep.get('queue_depth', 0):>5} "
+                f"{rep.get('routed', 0):>6} "
+                f"{jobs_r.get('done', 0):>6} "
+                f"{rep.get('demotions', 0):>6} "
+                f"{rep.get('sheds', 0):>4} "
+                f"{rep.get('mean_batch_occupancy', 0):>5.2f} "
+                f"{(str(hold) + 'ms') if hold is not None else '-':>8}"
+            )
 
     slo = payload.get("slo", {})
     lines.append(f"{bold}rolling SLO{reset} (k={slo.get('k')}, "

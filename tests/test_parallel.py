@@ -226,3 +226,122 @@ def test_shard_scorer_rejects_unknown_axis():
     mesh = make_mesh(2, axis_names=("data",))
     with pytest.raises(ValueError, match="no axis 'read'"):
         shard_scorer(jx, mesh)
+
+
+# ----------------------------------------- device topology (scale-out)
+
+
+def test_probe_device_count_caches_the_probe(monkeypatch):
+    from waffle_con_tpu.parallel import mesh
+
+    mesh.reset_probe_cache()
+    real = jax.devices
+    calls = []
+
+    def counting(*a):
+        calls.append(1)
+        return real(*a)
+
+    monkeypatch.setattr(jax, "devices", counting)
+    try:
+        n1 = mesh.probe_device_count()
+        n2 = mesh.probe_device_count()
+    finally:
+        mesh.reset_probe_cache()
+    assert n1 == n2 == len(real())
+    # the whole point: one backend probe per process, not per job
+    assert len(calls) == 1
+
+
+def test_device_slices_partitions_disjointly():
+    from waffle_con_tpu.parallel.mesh import device_slices
+
+    devs = [f"dev{i}" for i in range(8)]
+    slices = device_slices(3, devices=devs, name_prefix="rep")
+    assert [s.name for s in slices] == ["rep0", "rep1", "rep2"]
+    assert [len(s) for s in slices] == [3, 3, 2]
+    flat = [d for s in slices for d in s.devices]
+    assert flat == devs  # contiguous, disjoint, complete
+
+
+def test_device_slices_round_robin_when_oversubscribed():
+    from waffle_con_tpu.parallel.mesh import device_slices
+
+    devs = ["dev0", "dev1"]
+    slices = device_slices(4, devices=devs)
+    assert [s.devices for s in slices] == [
+        ("dev0",), ("dev1",), ("dev0",), ("dev1",),
+    ]
+    with pytest.raises(ValueError, match="n_slices"):
+        device_slices(0, devices=devs)
+
+
+def test_device_set_rejects_empty():
+    from waffle_con_tpu.parallel.mesh import DeviceSet
+
+    with pytest.raises(ValueError, match="empty"):
+        DeviceSet("none", ())
+
+
+def test_use_device_set_is_nested_and_thread_scoped():
+    from waffle_con_tpu.parallel.mesh import (
+        DeviceSet,
+        current_device_set,
+        use_device_set,
+    )
+
+    outer = DeviceSet("outer", ("dev0",))
+    inner = DeviceSet("inner", ("dev1",))
+    assert current_device_set() is None
+    with use_device_set(outer):
+        assert current_device_set() is outer
+        with use_device_set(inner):
+            assert current_device_set() is inner
+        assert current_device_set() is outer
+    assert current_device_set() is None
+
+    import threading
+
+    seen = []
+    with use_device_set(outer):
+        t = threading.Thread(
+            target=lambda: seen.append(current_device_set())
+        )
+        t.start()
+        t.join()
+    assert seen == [None]  # the pin is thread-local, not process-global
+
+
+@needs_devices(4)
+def test_make_mesh_draws_from_pinned_device_set():
+    from waffle_con_tpu.parallel.mesh import DeviceSet, use_device_set
+
+    devs = jax.devices()
+    pinned = DeviceSet("pin", tuple(devs[:2]))
+    with use_device_set(pinned):
+        mesh = make_mesh(axis_names=("read",))
+        assert mesh.devices.size == 2
+        # an explicit devices argument overrides the thread pin
+        mesh = make_mesh(devices=devs[:4], axis_names=("read",))
+        assert mesh.devices.size == 4
+    # outside the scope the full topology is back
+    assert make_mesh(axis_names=("read",)).devices.size == len(devs)
+
+
+@needs_devices(2)
+def test_shard_for_config_fails_fast_without_touching_the_scorer():
+    from waffle_con_tpu.parallel.mesh import (
+        DeviceSet,
+        shard_for_config,
+        use_device_set,
+    )
+
+    cfg = CdwfaConfigBuilder().backend("jax").mesh_shards(4).build()
+    tiny = DeviceSet("tiny", tuple(jax.devices()[:2]))
+    with use_device_set(tiny):
+        # scorer=None proves the availability check runs first: an
+        # over-asking config must fail before any state is built
+        with pytest.raises(ValueError, match="exceeds the 2 available"):
+            shard_for_config(None, cfg)
+    # unsharded configs are a no-op regardless of scorer
+    shard_for_config(None, CdwfaConfigBuilder().backend("jax").build())

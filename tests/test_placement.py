@@ -1,0 +1,136 @@
+"""Placement-policy contract tests: route admitted jobs by read count.
+
+:class:`PlacementPolicy` classifies each admitted job — at or above
+``large_read_threshold`` reads it becomes a mesh candidate, below it
+stays on the ragged-arena path — and promotion rewrites the job's
+config with an effective shard count clamped to the devices actually
+available and pow2-floored.  The policy must never reject work: every
+decline path returns ``None`` and the job runs unsharded.  The service
+integration test pins byte-identical results for a mesh-promoted job
+plus the ``mesh_placed`` counter.
+"""
+
+import dataclasses
+
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder
+from waffle_con_tpu.serve import (
+    ConsensusService,
+    JobRequest,
+    PlacementPolicy,
+    ServeConfig,
+)
+from waffle_con_tpu.serve.service import _build_engine
+from waffle_con_tpu.utils.example_gen import generate_test
+
+pytestmark = pytest.mark.serve
+
+
+def _jax_cfg(**kw):
+    b = CdwfaConfigBuilder().backend("jax")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _request(n_reads, config, seq_len=100):
+    _, reads = generate_test(4, seq_len, n_reads, 0.01, seed=n_reads)
+    return JobRequest(kind="single", reads=tuple(reads), config=config)
+
+
+# ----------------------------------------------------------- classifier
+
+
+def test_classify_threshold_boundary():
+    policy = PlacementPolicy(large_read_threshold=16, mesh_shards=2)
+    cfg = _jax_cfg(min_count=2)
+    assert policy.classify(_request(15, cfg)) == "arena"
+    assert policy.classify(_request(16, cfg)) == "mesh"
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="large_read_threshold"):
+        PlacementPolicy(large_read_threshold=0)
+    with pytest.raises(ValueError, match="mesh_shards"):
+        PlacementPolicy(mesh_shards=1)
+
+
+def test_effective_shards_clamps_and_pow2_floors():
+    policy = PlacementPolicy(large_read_threshold=16, mesh_shards=8)
+    assert policy.effective_shards(100, 8) == 8
+    # non-pow2 device pools round down so shards divide padded reads
+    assert policy.effective_shards(100, 6) == 4
+    assert policy.effective_shards(100, 3) == 2
+    # the job's own read count caps the split too
+    assert policy.effective_shards(3, 8) == 2
+    # degenerate pools yield < 2: no promotion
+    assert policy.effective_shards(100, 1) == 1
+    assert policy.effective_shards(0, 8) == 0
+
+
+# -------------------------------------------------------- place() paths
+
+
+def test_place_declines_small_python_and_explicit():
+    policy = PlacementPolicy(large_read_threshold=16, mesh_shards=2)
+    jcfg = _jax_cfg(min_count=2)
+
+    # small job: stays on the arena path
+    assert policy.place(_request(8, jcfg), 8) is None
+    # mesh_shards is a jax-scorer feature; python jobs never promote
+    pcfg = CdwfaConfigBuilder().backend("python").min_count(2).build()
+    assert policy.place(_request(24, pcfg), 8) is None
+    # config-less jobs can't be rewritten
+    assert policy.place(_request(24, None), 8) is None
+    # explicit caller-pinned shard count wins over the policy
+    pinned = dataclasses.replace(jcfg, mesh_shards=4)
+    assert policy.place(_request(24, pinned), 8) is None
+    # too few devices for >= 2 effective shards
+    assert policy.place(_request(24, jcfg), 1) is None
+
+
+def test_place_promotes_without_mutating_original():
+    policy = PlacementPolicy(large_read_threshold=16, mesh_shards=4)
+    cfg = _jax_cfg(min_count=2)
+    request = _request(24, cfg)
+    placed = policy.place(request, 8)
+    assert placed is not None
+    assert placed.config.mesh_shards == 4
+    assert placed.reads == request.reads
+    # promotion is a rewrite, not a mutation
+    assert request.config.mesh_shards == 0
+    assert cfg.mesh_shards == 0
+
+
+def test_place_clamps_to_device_pool():
+    policy = PlacementPolicy(large_read_threshold=16, mesh_shards=8)
+    placed = policy.place(_request(24, _jax_cfg(min_count=2)), 2)
+    assert placed is not None
+    assert placed.config.mesh_shards == 2
+
+
+# --------------------------------------------------- service integration
+
+
+def test_served_mesh_job_byte_identical_to_serial():
+    """A mesh-promoted job through the service equals the unsharded
+    serial run of the same request, and the promotion is counted."""
+    policy = PlacementPolicy(large_read_threshold=16, mesh_shards=2)
+    cfg = _jax_cfg(min_count=2, initial_band=12)
+    large = _request(16, cfg)
+    small = _request(6, cfg, seq_len=80)
+    want_large = _build_engine(large).consensus()
+    want_small = _build_engine(small).consensus()
+
+    with ConsensusService(
+        ServeConfig(workers=2, batch_window_s=0.002, placement=policy)
+    ) as svc:
+        h_large = svc.submit(large)
+        h_small = svc.submit(small)
+        assert h_large.result(timeout=300) == want_large
+        assert h_small.result(timeout=300) == want_small
+        stats = svc.stats()
+
+    assert stats["jobs"]["mesh_placed"] == 1
+    assert stats["jobs"]["done"] == 2

@@ -1,0 +1,247 @@
+"""Replicated front-door contract tests (python-backend, device-free).
+
+Core claims: N in-process replicas behind :class:`ReplicatedService`
+return results byte-identical to serial execution while the door
+spreads load by least-outstanding work; flight-trigger health signals
+(``backend_demoted`` / ``slow_search``) drain or deprioritize exactly
+the replica they're attributed to (by trace-id prefix); drained
+replicas re-admit once their outstanding work reaches zero; and the
+front door owns the ``WAFFLE_STATS_FILE`` payload with a per-replica
+table.  Jobs here run the python backend so the tests are fast and
+jax-free — routing and health logic are backend-agnostic.
+"""
+
+import json
+
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.serve import (
+    JobRequest,
+    ReplicatedConfig,
+    ReplicatedService,
+    ServeConfig,
+)
+from waffle_con_tpu.serve import replicas as serve_replicas
+from waffle_con_tpu.serve.service import _build_engine
+from waffle_con_tpu.utils.example_gen import generate_test
+
+pytestmark = pytest.mark.serve
+
+
+def _cfg(**kw):
+    b = CdwfaConfigBuilder().backend("python")
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _requests(n, seq_len=160, reads=6):
+    cfg = _cfg(min_count=2)
+    out = []
+    for seed in range(n):
+        _, r = generate_test(4, seq_len, reads, 0.02, seed=seed)
+        out.append(JobRequest(kind="single", reads=tuple(r), config=cfg))
+    return out
+
+
+def _door(replicas=2, **cfg_kw):
+    return ReplicatedService(ReplicatedConfig(
+        replicas=replicas,
+        base=ServeConfig(workers=2, batch_window_s=0.002),
+        **cfg_kw,
+    ))
+
+
+# ------------------------------------------------------ parity + routing
+
+
+def test_replicated_results_byte_identical_to_serial():
+    requests = _requests(6)
+    expected = [_build_engine(r).consensus() for r in requests]
+    with _door(replicas=2) as door:
+        handles = door.submit_all(requests)
+        results = [h.result(timeout=120) for h in handles]
+        stats = door.stats()
+    assert results == expected
+    assert stats["jobs"]["done"] == 6
+    assert stats["jobs"].get("failed", 0) == 0
+
+
+def test_least_outstanding_routing_uses_both_replicas():
+    requests = _requests(6)
+    with _door(replicas=2) as door:
+        handles = door.submit_all(requests)
+        for h in handles:
+            h.result(timeout=120)
+        reps = door.replica_stats()
+    routed = {r["replica"]: r["routed"] for r in reps}
+    assert sum(routed.values()) == 6
+    assert all(v >= 1 for v in routed.values()), routed
+
+
+def test_replica_names_and_trace_prefix():
+    with _door(replicas=2) as door:
+        handle = door.submit(_requests(1)[0])
+        handle.result(timeout=120)
+        names = [r["replica"] for r in door.replica_stats()]
+    assert names == ["consensus:r0", "consensus:r1"]
+    assert any(
+        handle.trace.trace_id.startswith(name + "/") for name in names
+    ), handle.trace.trace_id
+
+
+# ---------------------------------------------------- health transitions
+
+
+def test_backend_demotion_drains_attributed_replica(monkeypatch):
+    with _door(replicas=2) as door:
+        r0 = door._replicas[0]
+        # pin outstanding work so the drain can't re-admit mid-test
+        monkeypatch.setattr(r0.service, "outstanding", lambda: 1)
+        obs_flight.trigger(
+            "backend_demoted", trace_id=f"{r0.name}/job-999",
+            from_backend="jax",
+        )
+        reps = {r["replica"]: r for r in door.replica_stats()}
+        assert reps[r0.name]["state"] == serve_replicas.DRAINING
+        assert reps[r0.name]["demotions"] == 1
+        assert reps["consensus:r1"]["state"] == serve_replicas.UP
+
+        # new admissions reroute around the draining replica
+        handles = door.submit_all(_requests(3))
+        for h in handles:
+            h.result(timeout=120)
+        reps = {r["replica"]: r for r in door.replica_stats()}
+        assert reps[r0.name]["routed"] == 0
+        assert reps["consensus:r1"]["routed"] == 3
+
+
+def test_drained_replica_readmits_at_zero_outstanding():
+    with _door(replicas=2) as door:
+        r0 = door._replicas[0]
+        obs_flight.trigger(
+            "backend_demoted", trace_id=f"{r0.name}/job-998",
+            from_backend="jax",
+        )
+        assert door.replica_stats()[0]["state"] == serve_replicas.DRAINING
+        # outstanding is already 0, so the next routing decision
+        # re-admits before placing the job
+        door.submit(_requests(1)[0]).result(timeout=120)
+        rep = door.replica_stats()[0]
+        assert rep["state"] == serve_replicas.UP
+        assert rep["readmits"] == 1
+
+
+def test_slow_search_sheds_until_cooldown(monkeypatch):
+    with _door(replicas=2, shed_cooldown_s=120.0) as door:
+        r0 = door._replicas[0]
+        obs_flight.trigger(
+            "slow_search", trace_id=f"{r0.name}/job-997", p95_s=9.9,
+        )
+        assert door.replica_stats()[0]["state"] == serve_replicas.SHEDDING
+        # shedding deprioritizes: the job lands on the healthy replica
+        # even though r0 has equal outstanding work and a lower index
+        door.submit(_requests(1)[0]).result(timeout=120)
+        reps = {r["replica"]: r for r in door.replica_stats()}
+        assert reps[r0.name]["routed"] == 0
+        assert reps[r0.name]["sheds"] == 1
+        assert reps["consensus:r1"]["routed"] == 1
+        # expired cooldown restores the replica at the next decision
+        monkeypatch.setattr(r0, "shed_until", 0.0)
+        door.submit(_requests(1)[0]).result(timeout=120)
+        assert door.replica_stats()[0]["state"] == serve_replicas.UP
+
+
+def test_all_unhealthy_falls_back_to_least_outstanding(monkeypatch):
+    with _door(replicas=2) as door:
+        for i, rep in enumerate(door._replicas):
+            monkeypatch.setattr(rep.service, "outstanding", lambda: 0)
+            obs_flight.trigger(
+                "backend_demoted", trace_id=f"{rep.name}/job-{990 + i}",
+                from_backend="jax",
+            )
+            rep.state = serve_replicas.DRAINING
+            monkeypatch.setattr(
+                rep.service, "outstanding", (lambda: 1)
+            )
+        # every replica unhealthy: degraded routing still serves
+        handle = door.submit(_requests(1)[0])
+        assert handle.result(timeout=120) is not None
+
+
+def test_foreign_triggers_are_ignored():
+    with _door(replicas=2) as door:
+        obs_flight.trigger(
+            "backend_demoted", trace_id="someone-else/job-1",
+            from_backend="jax",
+        )
+        obs_flight.trigger("pool_exhausted",
+                           trace_id="consensus:r0/job-996")
+        obs_flight.trigger("backend_demoted", trace_id=None)
+        states = [r["state"] for r in door.replica_stats()]
+    assert states == [serve_replicas.UP, serve_replicas.UP]
+
+
+def test_close_detaches_listener():
+    door = _door(replicas=2)
+    r0_name = door._replicas[0].name
+    door.close()
+    # triggers after close must not touch the (closed) door's state
+    obs_flight.trigger(
+        "backend_demoted", trace_id=f"{r0_name}/job-995",
+        from_backend="jax",
+    )
+    assert door._replicas[0].state == serve_replicas.UP
+
+
+# ------------------------------------------------- flight trigger stream
+
+
+def test_trigger_listeners_receive_and_survive_errors():
+    calls = []
+
+    def listener(reason, trace_id, detail):
+        calls.append((reason, trace_id, dict(detail)))
+
+    def broken(reason, trace_id, detail):
+        raise RuntimeError("listener bug")
+
+    obs_flight.add_trigger_listener(broken)
+    obs_flight.add_trigger_listener(listener)
+    obs_flight.add_trigger_listener(listener)  # dedupe by identity
+    try:
+        obs_flight.trigger("unit_test_reason", trace_id="t/1", k=1)
+        # repeated (reason, trace) is deduped by the recorder but the
+        # listener stream sees every firing (health must not miss one)
+        obs_flight.trigger("unit_test_reason", trace_id="t/1", k=2)
+    finally:
+        obs_flight.remove_trigger_listener(listener)
+        obs_flight.remove_trigger_listener(broken)
+    assert calls == [
+        ("unit_test_reason", "t/1", {"k": 1}),
+        ("unit_test_reason", "t/1", {"k": 2}),
+    ]
+    obs_flight.trigger("unit_test_reason", trace_id="t/2")
+    assert len(calls) == 2  # removed listeners stay silent
+
+
+# ---------------------------------------------------------- stats payload
+
+
+def test_front_door_publishes_replica_table(monkeypatch, tmp_path):
+    stats_file = tmp_path / "stats.json"
+    monkeypatch.setenv("WAFFLE_STATS_FILE", str(stats_file))
+    with _door(replicas=2) as door:
+        for h in door.submit_all(_requests(2)):
+            h.result(timeout=120)
+    payload = json.loads(stats_file.read_text())
+    assert payload["service"] == "consensus"
+    table = payload["replicas"]
+    assert [r["replica"] for r in table] == [
+        "consensus:r0", "consensus:r1",
+    ]
+    for rep in table:
+        assert rep["state"] == serve_replicas.UP
+        assert "outstanding" in rep and "routed" in rep

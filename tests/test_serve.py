@@ -325,3 +325,176 @@ def test_serve_metrics_emitted():
     assert "waffle_serve_job_latency_seconds" in snap
     occupancy = snap["waffle_serve_batch_occupancy"]["series"]
     assert sum(s["count"] for s in occupancy.values()) > 0
+
+
+# ------------------------------------------- admission fairness (aging)
+
+
+class _FakeClock:
+    """Injectable monotonic clock for deterministic aging tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _queued_handle(job_id, priority):
+    from waffle_con_tpu.serve.job import JobHandle
+
+    return JobHandle(job_id, JobRequest(
+        kind="dual", reads=DUAL_READS, priority=priority,
+    ))
+
+
+def test_aging_preserves_strict_priority_inside_window():
+    from waffle_con_tpu.serve.scheduler import AdmissionQueue
+
+    clk = _FakeClock()
+    q = AdmissionQueue(10, aging_s=5.0, clock=clk)
+    low = _queued_handle(0, priority=0)
+    high = _queued_handle(1, priority=2)
+    q.put(low)
+    clk.t = 0.1
+    q.put(high)
+    # the low job has not aged: latency-sensitive traffic keeps its edge
+    assert q.get(timeout=0) is high
+    assert q.get(timeout=0) is low
+    assert q.aged_pops == 0
+
+
+def test_aged_low_priority_job_pops_through_a_high_flood():
+    from waffle_con_tpu.serve.scheduler import AdmissionQueue
+
+    clk = _FakeClock()
+    q = AdmissionQueue(100, aging_s=1.0, clock=clk)
+    low = _queued_handle(0, priority=0)
+    q.put(low)
+    highs = [_queued_handle(1 + i, priority=2) for i in range(50)]
+    for h in highs:
+        q.put(h)
+    clk.t = 2.0  # the low job is now past the aging window
+    assert q.get(timeout=0) is low
+    assert q.aged_pops == 1
+    # with the aged entry served, strict order resumes
+    assert q.get(timeout=0) is highs[0]
+
+
+def test_strict_priority_starves_without_aging():
+    from waffle_con_tpu.serve.scheduler import AdmissionQueue
+
+    clk = _FakeClock()
+    q = AdmissionQueue(100, aging_s=None, clock=clk)
+    low = _queued_handle(0, priority=0)
+    q.put(low)
+    highs = [_queued_handle(1 + i, priority=2) for i in range(5)]
+    for h in highs:
+        q.put(h)
+    clk.t = 1e6  # any finite aging window would have fired by now
+    assert [q.get(timeout=0) for _ in range(5)] == highs
+    assert q.get(timeout=0) is low
+    assert q.aged_pops == 0
+
+
+def test_admission_aging_property_over_synthetic_trace():
+    """Model-based fairness property: replay a random put/pop trace
+    against a reference model.  At every pop the queue must return the
+    strict-priority head UNLESS the oldest queued job has aged past the
+    window (and is not already the head), in which case it must return
+    that oldest job — so under arbitrary saturation no job ever waits
+    more than ``aging_s`` plus one dispatch."""
+    import numpy as np
+
+    from waffle_con_tpu.serve.scheduler import AdmissionQueue
+
+    rng = np.random.default_rng(7)
+    clk = _FakeClock()
+    aging = 0.5
+    q = AdmissionQueue(1000, aging_s=aging, clock=clk)
+    model = []  # entries mirror the heap tuples: (-prio, seq, t, handle)
+    seq = 0
+    aged_expected = 0
+    for _ in range(400):
+        clk.t += float(rng.exponential(0.05))
+        if rng.random() < 0.6 or not model:
+            prio = int(rng.integers(0, 3))
+            h = _queued_handle(seq, prio)
+            q.put(h)
+            model.append((-prio, seq, clk.t, h))
+            seq += 1
+            continue
+        head = min(model)
+        oldest = min(model, key=lambda e: e[1])
+        if clk.t - oldest[2] >= aging and oldest[1] != head[1]:
+            expect = oldest
+            aged_expected += 1
+        else:
+            expect = head
+        got = q.get(timeout=0)
+        assert got is expect[3], (
+            f"pop at t={clk.t:.3f} returned job {got.job_id}, "
+            f"model expected {expect[3].job_id}"
+        )
+        model.remove(expect)
+    assert q.aged_pops == aged_expected
+    assert aged_expected > 0, "trace never exercised the aging path"
+
+
+def test_service_surfaces_aged_pops():
+    cfg = _cfg(min_count=1)
+    with ConsensusService(
+        ServeConfig(workers=2, batch_window_s=0.0, aging_s=0.25)
+    ) as svc:
+        h = svc.submit(JobRequest(kind="dual", reads=DUAL_READS, config=cfg))
+        h.result(timeout=120)
+        stats = svc.stats()
+    assert stats["aged_pops"] == 0  # no saturation, no aged pops
+
+
+# --------------------------------------------- adaptive batch-window hold
+
+
+def test_adaptive_hold_surfaced_and_bounded():
+    cfg = _cfg(min_count=2)
+    _, reads = generate_test(4, 150, 6, 0.02, seed=5)
+    window_s = 0.05
+    with ConsensusService(
+        ServeConfig(workers=8, batch_window_s=window_s, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(
+            [JobRequest(kind="single", reads=tuple(reads), config=cfg)
+             for _ in range(8)]
+        )
+        for h in handles:
+            h.result(timeout=300)
+        dispatch = svc.stats()["dispatch"]
+    assert dispatch["adaptive_window"] is True
+    # the chosen hold is clamped to the configured window and to no
+    # less than a quarter of it (the floor of the adaptive band)
+    assert 0.0 < dispatch["last_hold_ms"] <= window_s * 1e3
+    assert dispatch["mean_hold_ms"] <= window_s * 1e3
+    # a burst of back-to-back submits leaves a warm (tiny) arrival EWMA
+    assert dispatch["ewma_arrival_gap_ms"] is not None
+    assert dispatch["ewma_arrival_gap_ms"] < window_s * 1e3
+
+
+def test_adaptive_hold_off_uses_fixed_window():
+    cfg = _cfg(min_count=2)
+    _, reads = generate_test(4, 120, 6, 0.02, seed=6)
+    window_s = 0.02
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=window_s,
+                    adaptive_window=False)
+    ) as svc:
+        handles = svc.submit_all(
+            [JobRequest(kind="single", reads=tuple(reads), config=cfg)
+             for _ in range(4)]
+        )
+        for h in handles:
+            h.result(timeout=300)
+        dispatch = svc.stats()["dispatch"]
+    assert dispatch["adaptive_window"] is False
+    # with adaptation off every parked batch holds the full window
+    assert dispatch["last_hold_ms"] == pytest.approx(window_s * 1e3)
+    assert dispatch["mean_hold_ms"] == pytest.approx(window_s * 1e3)

@@ -142,3 +142,27 @@ def test_cli_env_var_supplies_stats_file(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "queue_depth=3" in proc.stdout
+
+
+def test_render_replica_table(waffle_top):
+    payload = _payload()
+    payload["service"] = "consensus"
+    payload["replicas"] = [
+        {
+            "replica": "consensus:r0", "state": "up", "outstanding": 2,
+            "queue_depth": 1, "routed": 7, "demotions": 0, "sheds": 0,
+            "readmits": 0, "jobs": {"done": 5},
+            "mean_batch_occupancy": 1.5, "last_hold_ms": 1.2,
+        },
+        {
+            "replica": "consensus:r1", "state": "draining",
+            "outstanding": 0, "queue_depth": 0, "routed": 3,
+            "demotions": 1, "sheds": 0, "readmits": 0,
+            "jobs": {"done": 3}, "mean_batch_occupancy": 1.0,
+        },
+    ]
+    out = waffle_top.render(payload, plain=True)
+    assert "replicas (2)" in out
+    assert "consensus:r0" in out and "consensus:r1" in out
+    assert "draining" in out
+    assert "1.2ms" in out

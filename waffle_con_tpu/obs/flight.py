@@ -225,6 +225,40 @@ class FlightRecorder:
 
 _RECORDER = FlightRecorder()
 
+#: trigger listeners: called with (reason, trace_id, detail) on EVERY
+#: module-level trigger, BEFORE dedupe — health consumers (the replica
+#: front door's shedding logic) need each occurrence, not each unique
+#: incident.  Exceptions are swallowed: a broken listener must never
+#: take down the anomaly path.
+_LISTENERS: List = []
+_LISTENER_LOCK = threading.Lock()
+
+
+def add_trigger_listener(fn) -> None:
+    """Register ``fn(reason, trace_id, detail)`` on every trigger."""
+    with _LISTENER_LOCK:
+        if fn not in _LISTENERS:
+            _LISTENERS.append(fn)
+
+
+def remove_trigger_listener(fn) -> None:
+    with _LISTENER_LOCK:
+        try:
+            _LISTENERS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _notify_listeners(reason: str, trace_id: Optional[str],
+                      detail: Dict) -> None:
+    with _LISTENER_LOCK:
+        listeners = list(_LISTENERS)
+    for fn in listeners:
+        try:
+            fn(reason, trace_id, detail)
+        except Exception:  # noqa: BLE001 - listeners must never break
+            pass
+
 
 def get_recorder() -> FlightRecorder:
     return _RECORDER
@@ -236,6 +270,7 @@ def record(kind: str, /, trace_id: Optional[str] = None, **fields) -> None:
 
 def trigger(reason: str, trace_id: Optional[str] = None,
             **detail) -> Optional[Dict]:
+    _notify_listeners(reason, trace_id, detail)
     return _RECORDER.trigger(reason, trace_id=trace_id, **detail)
 
 

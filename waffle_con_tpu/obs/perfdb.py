@@ -71,6 +71,11 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "parity", "ragged_occupancy", "compiles_ragged",
         "ragged_stats", "bucketed_run_occupancy", "jobs_per_s_ragged",
     ),
+    "storm": (
+        "parity", "jobs_per_s", "jobs_per_s_single",
+        "speedup_vs_single", "p95_job_latency_s", "p99_job_latency_s",
+        "replicas", "per_replica", "mesh_placed", "shed",
+    ),
     "microbench": ("parity", "steps", "stop_code", "breakdown"),
     "north-star": ("parity", "vs_baseline", "breakdown"),
 }

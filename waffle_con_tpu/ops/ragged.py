@@ -832,7 +832,7 @@ class BandArena:
 
         self._ensure_pool()
         if self._kernel is None:
-            self._kernel = self._build_kernel()
+            self._kernel = _shared_kernel(self)
         js._note_compile(
             "j_run_ragged", (P, self.W, self.L, self.C, G1, self.A)
         )
@@ -941,10 +941,40 @@ class BandArena:
 
 
 # ======================================================================
-# process-wide arena + module-level API (what the serve layer calls)
+# shared ragged kernel
+#
+# _build_kernel's jitted body closes over nothing per-instance — every
+# geometry input arrives as a (shape-keyed) argument — so one jit
+# closure serves every arena in the process.  Replicated serving spins
+# up one arena per replica; without this cache each would recompile the
+# identical kernel ladder.
+
+_KERNEL_LOCK = threading.Lock()
+_RAGGED_KERNEL = None
+
+
+def _shared_kernel(arena: "BandArena"):
+    global _RAGGED_KERNEL
+    with _KERNEL_LOCK:
+        if _RAGGED_KERNEL is None:
+            _RAGGED_KERNEL = arena._build_kernel()
+        return _RAGGED_KERNEL
+
+
+# ======================================================================
+# process-wide arena registry + module-level API (what the serve layer
+# calls).  The DEFAULT arena backs the single-service path exactly as
+# before; replicas create NAMED arenas (one per replica) so residency,
+# paging, and gang formation stay replica-local.  Scorer-keyed lookups
+# (take_injected / release_scorer / discard_injected) search every
+# arena — id(scorer) is process-unique, so at most one arena answers —
+# which keeps the call sites inside jax_scorer.py arena-agnostic.
+# Job-id-keyed release is arena-scoped: job ids are per-service
+# counters and WOULD collide across replicas.
 
 _ARENA: Optional[BandArena] = None
 _ARENA_LOCK = threading.Lock()
+_NAMED_ARENAS: Dict[str, BandArena] = {}
 
 
 def get_arena() -> BandArena:
@@ -959,26 +989,50 @@ def peek_arena() -> Optional[BandArena]:
     return _ARENA
 
 
+def new_arena(name: str, config: Optional[ArenaConfig] = None) -> BandArena:
+    """Create (or replace) the named arena — one per serve replica."""
+    arena = BandArena(config or ArenaConfig.from_env())
+    with _ARENA_LOCK:
+        _NAMED_ARENAS[name] = arena
+    return arena
+
+
+def drop_arena(name: str) -> None:
+    with _ARENA_LOCK:
+        _NAMED_ARENAS.pop(name, None)
+
+
+def _all_arenas() -> List[BandArena]:
+    with _ARENA_LOCK:
+        out = [] if _ARENA is None else [_ARENA]
+        out.extend(_NAMED_ARENAS.values())
+        return out
+
+
 def reset_arena() -> None:
-    """Drop the process arena (tests re-read the env knobs; any device
-    pool memory is released with it)."""
+    """Drop the process arena and any named replica arenas (tests
+    re-read the env knobs; any device pool memory is released with
+    them)."""
     global _ARENA
     with _ARENA_LOCK:
         _ARENA = None
+        _NAMED_ARENAS.clear()
 
 
-def gang_width() -> int:
-    return get_arena().gang
+def gang_width(arena: Optional[BandArena] = None) -> int:
+    return (arena or get_arena()).gang
 
 
-def probe(payload, ticket=None) -> Optional[RunSpec]:
+def probe(payload, ticket=None,
+          arena: Optional[BandArena] = None) -> Optional[RunSpec]:
     """Resolve one parked ``run_extend`` dispatch into a gang member.
 
     ``payload`` is ``(probe_attr, args, kwargs)`` captured by the
     coalescing proxy; ``probe_attr`` hops the proxy/supervisor stack to
     the live ``JaxScorer`` endpoint (or None when the current backend
-    cannot take part).  Returns None — bucketed/solo fallback — on any
-    ineligibility, including pool exhaustion."""
+    cannot take part).  ``arena`` pins admission to one replica's
+    arena (default: the process arena).  Returns None — bucketed/solo
+    fallback — on any ineligibility, including pool exhaustion."""
     if not enabled():
         return None
     probe_fn, args, kwargs = payload
@@ -992,7 +1046,7 @@ def probe(payload, ticket=None) -> Optional[RunSpec]:
     if endpoint is None:
         return None
     scorer, bh = endpoint
-    arena = get_arena()
+    arena = arena if arena is not None else get_arena()
     if not arena.eligible(scorer, vals):
         return None
     job_id = getattr(ticket, "job_id", None)
@@ -1003,37 +1057,43 @@ def probe(payload, ticket=None) -> Optional[RunSpec]:
     )
 
 
-def run_group(specs: List[RunSpec]) -> List[Tuple[int, int]]:
-    return get_arena().run_group(specs)
+def run_group(specs: List[RunSpec],
+              arena: Optional[BandArena] = None) -> List[Tuple[int, int]]:
+    return (arena if arena is not None else get_arena()).run_group(specs)
 
 
 def take_injected(scorer, h: int):
-    a = _ARENA
-    if a is None:
-        return None
-    return a.take_injected(scorer, h)
+    for a in _all_arenas():
+        inj = a.take_injected(scorer, h)
+        if inj is not None:
+            return inj
+    return None
 
 
-def discard_injected(keys) -> None:
-    a = _ARENA
-    if a is not None:
+def discard_injected(keys, arena: Optional[BandArena] = None) -> None:
+    if arena is not None:
+        arena.discard_injected(keys)
+        return
+    for a in _all_arenas():
         a.discard_injected(keys)
 
 
 def release_scorer(scorer) -> None:
-    a = _ARENA
-    if a is not None:
+    for a in _all_arenas():
         a.release_scorer(scorer)
 
 
-def release_job(job_id) -> None:
+def release_job(job_id, arena: Optional[BandArena] = None) -> None:
+    if arena is not None:
+        arena.release_job(job_id)
+        return
     a = _ARENA
     if a is not None:
         a.release_job(job_id)
 
 
-def arena_stats() -> Dict:
-    a = _ARENA
+def arena_stats(arena: Optional[BandArena] = None) -> Dict:
+    a = arena if arena is not None else _ARENA
     if a is None:
         return {"active": False, "enabled": enabled()}
     return a.stats()

@@ -2,12 +2,20 @@
 reduction."""
 
 from waffle_con_tpu.parallel.mesh import (
+    DeviceSet,
+    current_device_set,
+    device_slices,
     make_mesh,
+    probe_device_count,
+    reset_probe_cache,
     shard_for_config,
     shard_scorer,
     sharded_col_step,
+    use_device_set,
 )
 
 __all__ = [
-    "make_mesh", "shard_for_config", "shard_scorer", "sharded_col_step",
+    "DeviceSet", "current_device_set", "device_slices", "make_mesh",
+    "probe_device_count", "reset_probe_cache", "shard_for_config",
+    "shard_scorer", "sharded_col_step", "use_device_set",
 ]

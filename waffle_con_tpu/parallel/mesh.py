@@ -29,7 +29,11 @@ simply makes the same ``psum`` cross DCN.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,17 +51,141 @@ else:
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+#: (platform-key -> device count) probe cache.  ``jax.devices()`` is
+#: cheap once the backend exists, but the FIRST call initialises the
+#: platform — and a missing device plugin makes that initialisation
+#: retry (and log) on every call.  shard_for_config used to pay that
+#: probe per admitted job; now the answer is taken once per process.
+_PROBE_LOCK = threading.Lock()
+_PROBE_CACHE: Dict[str, int] = {}
+
+
+def probe_device_count() -> int:
+    """Cached local device count for the pinned platform.
+
+    Mirrors ``bench.py``'s device probe contract: an explicit
+    ``JAX_PLATFORMS=cpu`` pin is trusted outright — the probe asks the
+    already-selected backend and never attempts to initialise another
+    plugin — and the outcome (including the count) is cached for the
+    process lifetime so per-job placement decisions cost a dict hit.
+    """
+    key = os.environ.get("JAX_PLATFORMS", "") or "default"
+    with _PROBE_LOCK:
+        cached = _PROBE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    n = len(jax.devices())
+    with _PROBE_LOCK:
+        _PROBE_CACHE[key] = n
+    return n
+
+
+def reset_probe_cache() -> None:
+    """Forget cached probe outcomes (tests re-pinning platforms)."""
+    with _PROBE_LOCK:
+        _PROBE_CACHE.clear()
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSet:
+    """A named, ordered slice of the local device topology.
+
+    Replicas pin their workers to disjoint sets so mesh-sharded jobs
+    on different replicas partition onto different chips and run
+    concurrently instead of contending for the full device list.
+    """
+
+    name: str
+    devices: Tuple[Any, ...]
+
+    def __post_init__(self) -> None:
+        if not self.devices:
+            raise ValueError(f"device set {self.name!r} is empty")
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def mesh(self, n_devices: Optional[int] = None,
+             shape: Optional[Sequence[int]] = None,
+             axis_names: Sequence[str] = ("read",)) -> Mesh:
+        return make_mesh(n_devices, shape, axis_names,
+                         devices=self.devices)
+
+
+def device_slices(n_slices: int,
+                  devices: Optional[Sequence[Any]] = None,
+                  name_prefix: str = "slice") -> List[DeviceSet]:
+    """Partition the local devices into ``n_slices`` contiguous sets.
+
+    With at least one device per slice the sets are disjoint (sizes
+    differ by at most one); with more slices than devices each slice
+    gets one device round-robin — oversubscribed, but every replica
+    still owns a valid placement target.
+    """
+    if n_slices < 1:
+        raise ValueError(f"need n_slices >= 1, got {n_slices}")
+    devs = tuple(devices) if devices is not None else tuple(jax.devices())
+    out: List[DeviceSet] = []
+    if len(devs) >= n_slices:
+        base, rem = divmod(len(devs), n_slices)
+        start = 0
+        for i in range(n_slices):
+            size = base + (1 if i < rem else 0)
+            out.append(DeviceSet(f"{name_prefix}{i}",
+                                 devs[start:start + size]))
+            start += size
+    else:
+        for i in range(n_slices):
+            out.append(DeviceSet(f"{name_prefix}{i}",
+                                 (devs[i % len(devs)],)))
+    return out
+
+
+_TLS = threading.local()
+
+
+def current_device_set() -> Optional[DeviceSet]:
+    """The device set pinned on this thread, or ``None`` (all devices)."""
+    return getattr(_TLS, "device_set", None)
+
+
+@contextlib.contextmanager
+def use_device_set(device_set: Optional[DeviceSet]):
+    """Pin mesh construction on this thread to ``device_set``.
+
+    Replica worker threads wrap job execution in this scope so the
+    existing ``construct_backend -> shard_for_config`` path lands
+    sharded state on the replica's slice without plumbing a device
+    argument through every layer.
+    """
+    prev = current_device_set()
+    _TLS.device_set = device_set
+    try:
+        yield device_set
+    finally:
+        _TLS.device_set = prev
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     shape: Optional[Sequence[int]] = None,
     axis_names: Sequence[str] = ("read",),
+    devices: Optional[Sequence[Any]] = None,
 ) -> Mesh:
     """Build a mesh over the first ``n_devices`` (or all) devices.
 
     ``shape`` reshapes the device list for multi-axis meshes, e.g.
-    ``shape=(2, 4), axis_names=("branch", "read")``.
+    ``shape=(2, 4), axis_names=("branch", "read")``.  ``devices``
+    overrides the pool the mesh draws from; when omitted, the
+    thread's :func:`current_device_set` (if any) wins over the global
+    ``jax.devices()`` list so replica threads shard onto their slice.
     """
-    devices = jax.devices()
+    if devices is not None:
+        devices = list(devices)
+    else:
+        pinned = current_device_set()
+        devices = list(pinned.devices) if pinned is not None \
+            else jax.devices()
     if n_devices is not None:
         if n_devices > len(devices):
             raise ValueError(
@@ -134,9 +262,22 @@ def shard_for_config(scorer, config) -> None:
 
     One place for the make-a-mesh-and-shard snippet so the supervisor's
     mid-search fallback construction places state exactly like
-    ``make_scorer`` does."""
-    if config.mesh_shards:
-        shard_scorer(scorer, make_mesh(config.mesh_shards))
+    ``make_scorer`` does.  The availability check runs against the
+    cached :func:`probe_device_count` (or the thread's pinned device
+    set), so a config demanding more shards than the platform has
+    fails fast without re-initialising a backend per job."""
+    shards = getattr(config, "mesh_shards", 0)
+    if not shards:
+        return
+    pinned = current_device_set()
+    available = len(pinned) if pinned is not None else probe_device_count()
+    if shards > available:
+        raise ValueError(
+            f"config.mesh_shards={shards} exceeds the "
+            f"{available} available device(s)"
+            + (f" in device set {pinned.name!r}" if pinned else "")
+        )
+    shard_scorer(scorer, make_mesh(shards))
 
 
 def sharded_col_step(mesh: Mesh, read_axis: str = "read", num_symbols: int = 32):

@@ -26,6 +26,14 @@ band-state arena (:mod:`waffle_con_tpu.ops.ragged`); pool exhaustion
 raises the typed :class:`~waffle_con_tpu.ops.ragged.ArenaExhausted`
 internally and degrades to the bucketed path.
 
+Scale-out serving: :class:`~waffle_con_tpu.serve.placement.PlacementPolicy`
+routes large admitted jobs through a mesh-sharded scorer (small jobs
+keep the arena path), and
+:class:`~waffle_con_tpu.serve.replicas.ReplicatedService` fronts N
+in-process replicas — each with its own dispatcher, arena, worker pool
+and device slice — with least-outstanding, health-aware routing
+(``waffle_replica_*`` gauges; demoted replicas drain and re-admit).
+
 Observability: ``waffle_serve_queue_depth``/``waffle_serve_active_jobs``
 gauges, ``waffle_serve_jobs_total{outcome}`` /
 ``waffle_serve_admission_rejections_total`` /
@@ -52,6 +60,11 @@ from waffle_con_tpu.serve.job import (
     ServiceClosed,
     ServiceOverloaded,
 )
+from waffle_con_tpu.serve.placement import PlacementPolicy
+from waffle_con_tpu.serve.replicas import (
+    ReplicatedConfig,
+    ReplicatedService,
+)
 from waffle_con_tpu.serve.scheduler import AdmissionQueue, WorkerPool
 from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
 
@@ -66,6 +79,9 @@ __all__ = [
     "JobHandle",
     "JobRequest",
     "JobStatus",
+    "PlacementPolicy",
+    "ReplicatedConfig",
+    "ReplicatedService",
     "ServeConfig",
     "ServeError",
     "ServiceClosed",

@@ -126,13 +126,33 @@ class BatchingDispatcher:
     company; ``max_batch`` bounds how much company it waits *for* (the
     wait target is ``min(max_batch, active_jobs)`` — there is no point
     waiting for more requests than there are jobs able to send one).
+
+    With ``adaptive_window`` (default on) the wait inside that cap is
+    arrival-rate-predictive: the dispatcher keeps an EWMA of recent
+    inter-arrival gaps and, after each arrival, holds only
+    ``max(4 x ewma_gap, window_s / 4)`` for the next one (clamped to
+    the configured window).  Under a burst the gaps are tiny, the hold
+    refreshes per arrival, and the gang fills to target; when arrivals
+    stall the batch launches early instead of idling out the full
+    fixed window.  Worst-case added latency is unchanged (the absolute
+    ``window_s`` cap from first park still applies); a cold EWMA falls
+    back to the fixed window.  The chosen hold is surfaced in
+    :meth:`stats` (and from there in serve evidence).
+
+    ``arena`` pins the ragged gang pass to one replica's band-state
+    arena; ``None`` uses the process arena (single-service behavior).
     """
+
+    #: EWMA smoothing for inter-arrival gaps (~last 10 arrivals)
+    EWMA_ALPHA = 0.2
 
     def __init__(
         self,
         window_s: float = 0.002,
         max_batch: int = 8,
         name: str = "consensus",
+        adaptive_window: bool = True,
+        arena=None,
     ) -> None:
         if window_s < 0:
             raise ValueError("window_s must be >= 0")
@@ -140,12 +160,22 @@ class BatchingDispatcher:
             raise ValueError("max_batch must be >= 1")
         self.window_s = window_s
         self.max_batch = max_batch
+        self.adaptive_window = adaptive_window
+        self._arena = arena
         self._name = name
         self._cond = threading.Condition()
         self._pending: List[_DispatchRequest] = []
         self._active_jobs = 0
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # adaptive-hold state (all under the lock): monotonic time of
+        # the last routed arrival, the smoothed gap, and the hold the
+        # batching loop last chose
+        self._last_arrival: Optional[float] = None
+        self._ewma_gap: Optional[float] = None
+        self._last_hold_s: float = window_s
+        self._hold_sum = 0.0
+        self._hold_batches = 0
         # internal stats, always maintained (cheap ints under the lock);
         # the obs serve_* metrics mirror them when metrics are enabled
         self._stats = {
@@ -233,6 +263,18 @@ class BatchingDispatcher:
                 # inside the worker's open search span, so the "s" event
                 # temporally precedes the dispatcher-side "f"
                 obs_trace.get_tracer().flow("s", id(req))
+                now = time.monotonic()
+                if self._last_arrival is not None:
+                    # idle stretches are not "inter-arrival" signal:
+                    # clamp the sample so one quiet second cannot park
+                    # the EWMA above the window for the next burst
+                    gap = min(now - self._last_arrival, 4 * self.window_s)
+                    self._ewma_gap = (
+                        gap if self._ewma_gap is None
+                        else (self.EWMA_ALPHA * gap
+                              + (1 - self.EWMA_ALPHA) * self._ewma_gap)
+                    )
+                self._last_arrival = now
                 self._pending.append(req)
                 self._stats["routed_requests"] += 1
                 self._cond.notify_all()
@@ -278,14 +320,31 @@ class BatchingDispatcher:
                     return
                 # bounded batching window: wait for company up to
                 # window_s, but never for more requests than there are
-                # other active jobs to send them
+                # other active jobs to send them.  Inside that cap the
+                # adaptive hold trims the wait to a multiple of the
+                # observed inter-arrival gap, refreshed per arrival.
                 target = min(self.max_batch, max(2, self._active_jobs))
-                deadline = time.monotonic() + self.window_s
+                cap = time.monotonic() + self.window_s
+                hold = self.window_s
                 while len(self._pending) < target and not self._closed:
-                    remaining = deadline - time.monotonic()
+                    now = time.monotonic()
+                    if self.adaptive_window and self._ewma_gap is not None:
+                        hold = min(
+                            self.window_s,
+                            max(4 * self._ewma_gap, self.window_s / 4),
+                        )
+                        deadline = min(
+                            cap, (self._last_arrival or now) + hold
+                        )
+                    else:
+                        deadline = cap
+                    remaining = deadline - now
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
+                self._last_hold_s = hold
+                self._hold_sum += hold
+                self._hold_batches += 1
                 batch = self._pending[:]
                 del self._pending[:]
             self._execute(batch)
@@ -306,7 +365,7 @@ class BatchingDispatcher:
             # a member whose dispatch raised before reaching the scorer
             # (abort/deadline) must not leave a stale injection behind
             if injected_keys:
-                ops_ragged.discard_injected(injected_keys)
+                ops_ragged.discard_injected(injected_keys, arena=self._arena)
 
     def _ragged_pass(self, batch: List[_DispatchRequest]) -> List[tuple]:
         specs = []
@@ -315,7 +374,9 @@ class BatchingDispatcher:
             if req.ragged is None:
                 continue
             try:
-                spec = ops_ragged.probe(req.ragged, req.ticket)
+                spec = ops_ragged.probe(
+                    req.ragged, req.ticket, arena=self._arena
+                )
             except Exception:  # noqa: BLE001 - probe failure = solo
                 logger.debug("ragged probe failed", exc_info=True)
                 continue
@@ -331,7 +392,7 @@ class BatchingDispatcher:
         if len(specs) < 2:
             return []
         keys: List[tuple] = []
-        gang = ops_ragged.gang_width()
+        gang = ops_ragged.gang_width(self._arena)
         for i in range(0, len(specs), gang):
             chunk = specs[i:i + gang]
             if len(chunk) < 2:
@@ -339,7 +400,7 @@ class BatchingDispatcher:
             with obs_trace.span(
                 "serve:ragged", "serve", members=len(chunk)
             ):
-                got = ops_ragged.run_group(chunk)
+                got = ops_ragged.run_group(chunk, arena=self._arena)
             if not got:
                 continue
             keys.extend(got)
@@ -429,6 +490,17 @@ class BatchingDispatcher:
     def stats(self) -> Dict:
         with self._cond:
             s = dict(self._stats)
+            s["adaptive_window"] = self.adaptive_window
+            s["window_s"] = self.window_s
+            s["last_hold_ms"] = round(self._last_hold_s * 1e3, 4)
+            s["mean_hold_ms"] = round(
+                (self._hold_sum / self._hold_batches * 1e3)
+                if self._hold_batches else self.window_s * 1e3, 4
+            )
+            s["ewma_arrival_gap_ms"] = (
+                round(self._ewma_gap * 1e3, 4)
+                if self._ewma_gap is not None else None
+            )
         batches = s["coalesced_batches"] + s["solo_batches"]
         s["batches"] = batches
         s["mean_batch_occupancy"] = (

@@ -1,0 +1,103 @@
+"""Shard placement for admitted jobs: route by read count.
+
+The serve layer has two execution substrates with opposite sweet
+spots.  Small jobs amortize launch overhead by *ganging* — the paged
+band-state arena steps many jobs in one ragged kernel call — while a
+single large job has enough rows to fill wide hardware on its own and
+wants the *mesh* instead: ``parallel.mesh.shard_scorer`` splits its
+read axis across devices and lets GSPMD insert the cross-chip
+reductions.  :class:`PlacementPolicy` is the classifier that picks per
+admitted job.
+
+Mechanism: promotion happens at admission by rewriting the job's
+config (``dataclasses.replace(config, mesh_shards=n)``) — the
+existing ``construct_backend -> shard_for_config`` path then places
+the scorer's state on the mesh with zero new code in the engines, and
+the arena's eligibility gate already rejects sharded scorers, so the
+two substrates stay naturally exclusive.  Results are byte-identical
+either way (mesh parity is pinned by ``tests/test_parallel.py``; the
+storm harness re-verifies per job against serial references).
+
+The policy never *forces* hardware that is not there: the effective
+shard count is clamped to the available device pool (the replica's
+:class:`~waffle_con_tpu.parallel.mesh.DeviceSet` when pinned, else
+the cached probe) and rounded down to a power of two so it always
+divides the scorer's pow2-padded read count.  Below 2 effective
+shards the job simply stays on the arena path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from waffle_con_tpu.serve.job import JobRequest
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPolicy:
+    """Classify admitted jobs by read count and pick their substrate.
+
+    * ``large_read_threshold`` — jobs with at least this many reads are
+      mesh candidates; smaller jobs stay on the ragged-arena path.
+    * ``mesh_shards`` — requested read-axis shard count for promoted
+      jobs (clamped to the devices actually available at placement
+      time, pow2-floored).
+    """
+
+    large_read_threshold: int = 64
+    mesh_shards: int = 2
+
+    def __post_init__(self) -> None:
+        if self.large_read_threshold < 1:
+            raise ValueError("large_read_threshold must be >= 1")
+        if self.mesh_shards < 2:
+            raise ValueError(
+                "mesh_shards must be >= 2 (1 shard is just the "
+                "unsharded engine; use placement=None instead)"
+            )
+
+    def classify(self, request: JobRequest) -> str:
+        """``"mesh"`` or ``"arena"`` for one job."""
+        return (
+            "mesh" if len(request.reads) >= self.large_read_threshold
+            else "arena"
+        )
+
+    def effective_shards(self, n_reads: int, available_devices: int) -> int:
+        """Shard count a promoted job actually gets: the policy ask,
+        clamped to the device pool and to the job's own read count,
+        pow2-floored (so it divides the pow2-padded read axis).  < 2
+        means no promotion."""
+        return _pow2_floor(
+            min(self.mesh_shards, available_devices, max(n_reads, 0))
+        )
+
+    def place(self, request: JobRequest,
+              available_devices: int) -> Optional[JobRequest]:
+        """Return the mesh-promoted request, or ``None`` to leave the
+        job on the arena path.
+
+        Declines when: the job is small, the backend is not jax
+        (``mesh_shards`` is a jax-scorer feature), the caller already
+        pinned an explicit shard count (explicit config wins), or the
+        device pool yields fewer than 2 effective shards.
+        """
+        if self.classify(request) != "mesh":
+            return None
+        config = request.config
+        if config is None or getattr(config, "backend", None) != "jax":
+            return None
+        if getattr(config, "mesh_shards", 0):
+            return None
+        shards = self.effective_shards(len(request.reads),
+                                       available_devices)
+        if shards < 2:
+            return None
+        return dataclasses.replace(
+            request, config=dataclasses.replace(config, mesh_shards=shards)
+        )

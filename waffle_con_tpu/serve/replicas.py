@@ -1,0 +1,407 @@
+"""Replicated front door: N in-process serve replicas behind one door.
+
+Scale-out inside one process: each replica is a full
+:class:`~waffle_con_tpu.serve.service.ConsensusService` — its own
+admission queue, batching dispatcher, ragged band arena, and worker
+pool — pinned to a disjoint :class:`~waffle_con_tpu.parallel.mesh.DeviceSet`
+slice of the local topology.  :class:`ReplicatedService` is the shared
+admission point in front of them:
+
+* **least-outstanding-work routing** — every submit goes to the
+  healthy replica with the fewest admitted-but-unfinished jobs; a
+  replica at its admission limit overflows to the next-best instead of
+  rejecting the client.
+* **health-driven shedding** — the front door listens to the flight
+  recorder's trigger stream (the same always-on signals the incident
+  path uses).  A ``backend_demoted`` on a replica puts it in
+  ``draining``: no new admissions until its outstanding work reaches
+  zero, then it re-admits automatically (circuit-break drain /
+  re-admit).  A ``slow_search`` puts it in ``shedding`` for a
+  cooldown: routing prefers other replicas while its latency recovers.
+  When every replica is unhealthy the door falls back to plain
+  least-outstanding — degraded beats down.
+* **per-replica observability** — ``waffle_replica_*`` gauges and
+  counters, a ``replicas`` table in the ``WAFFLE_STATS_FILE`` payload
+  (rendered by ``scripts/waffle_top.py``), and runtime events for
+  every state transition.  The front door owns stats publication; the
+  member services have theirs disabled so N replicas never clobber
+  one file.
+
+Results stay byte-identical to serial execution: replicas add routing,
+not math — each job still runs on exactly one service, and the ragged
+arena / mesh placement parity contracts hold per replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import slo as obs_slo
+from waffle_con_tpu.ops import ragged as ops_ragged
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.serve.job import (
+    JobHandle,
+    JobRequest,
+    ServiceClosed,
+    ServiceOverloaded,
+)
+from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+
+#: replica states
+UP = "up"
+DRAINING = "draining"    # circuit-break: no admissions until drained
+SHEDDING = "shedding"    # latency flag: deprioritized for a cooldown
+
+#: flight-trigger reasons the health listener acts on
+_HEALTH_REASONS = ("backend_demoted", "slow_search")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicatedConfig:
+    """Front-door knobs.
+
+    * ``replicas`` — member service count; each gets its own
+      dispatcher, arena, worker pool, and device slice.
+    * ``base`` — per-replica :class:`ServeConfig` template (name is
+      rewritten to ``<name>:r<i>`` per replica).
+    * ``shed_cooldown_s`` — how long a ``slow_search``-flagged replica
+      stays deprioritized.
+    """
+
+    replicas: int = 2
+    base: Optional[ServeConfig] = None
+    name: str = "consensus"
+    shed_cooldown_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.shed_cooldown_s < 0:
+            raise ValueError("shed_cooldown_s must be >= 0")
+
+
+class _Replica:
+    """Mutable per-replica record (state guarded by the door's lock)."""
+
+    __slots__ = ("index", "name", "service", "arena", "device_set",
+                 "state", "shed_until", "routed", "demotions", "sheds",
+                 "readmits")
+
+    def __init__(self, index: int, name: str, service: ConsensusService,
+                 arena, device_set) -> None:
+        self.index = index
+        self.name = name
+        self.service = service
+        self.arena = arena
+        self.device_set = device_set
+        self.state = UP
+        self.shed_until = 0.0
+        self.routed = 0
+        self.demotions = 0
+        self.sheds = 0
+        self.readmits = 0
+
+
+class ReplicatedService:
+    """N serve replicas behind least-outstanding, health-aware routing.
+
+    Usage::
+
+        with ReplicatedService(ReplicatedConfig(replicas=2)) as door:
+            handles = [door.submit(req) for req in requests]
+            results = [h.result() for h in handles]
+    """
+
+    def __init__(
+        self,
+        config: Optional[ReplicatedConfig] = None,
+        autostart: bool = True,
+    ) -> None:
+        self.config = config if config is not None else ReplicatedConfig()
+        base = (self.config.base if self.config.base is not None
+                else ServeConfig())
+        self._lock = threading.Lock()
+        self._closed = False
+        self._stats_published_at = 0.0
+        slices = self._device_slices(self.config.replicas)
+        self._replicas: List[_Replica] = []
+        for i in range(self.config.replicas):
+            rname = f"{self.config.name}:r{i}"
+            arena = ops_ragged.new_arena(rname)
+            service = ConsensusService(
+                dataclasses.replace(base, name=rname),
+                autostart=False,
+                device_set=slices[i],
+                arena=arena,
+                publish_stats=False,
+            )
+            self._replicas.append(
+                _Replica(i, rname, service, arena, slices[i])
+            )
+        obs_flight.add_trigger_listener(self._on_trigger)
+        if autostart:
+            self.start()
+
+    @staticmethod
+    def _device_slices(n: int) -> List:
+        """Disjoint device slices for the replicas, or all-``None``
+        when the stack has no importable device runtime (python-backend
+        services still replicate fine — they just share the host)."""
+        try:
+            from waffle_con_tpu.parallel import mesh as par_mesh
+
+            return list(par_mesh.device_slices(n, name_prefix="replica"))
+        except Exception:  # noqa: BLE001 - jax-less / deviceless stack
+            return [None] * n
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        for rep in self._replicas:
+            rep.service.start()
+
+    def close(
+        self, cancel_pending: bool = False, timeout: Optional[float] = None
+    ) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        obs_flight.remove_trigger_listener(self._on_trigger)
+        for rep in self._replicas:
+            rep.service.close(cancel_pending=cancel_pending,
+                              timeout=timeout)
+        for rep in self._replicas:
+            ops_ragged.drop_arena(rep.name)
+
+    def __enter__(self) -> "ReplicatedService":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    # -- health --------------------------------------------------------
+
+    def _on_trigger(self, reason: str, trace_id: Optional[str],
+                    detail: Dict) -> None:
+        """Flight-trigger listener: attribute health signals to a
+        replica by trace-id prefix (job trace ids are
+        ``<replica-name>/job-<id>``) and transition its state."""
+        if reason not in _HEALTH_REASONS or not trace_id:
+            return
+        rep = next(
+            (r for r in self._replicas
+             if trace_id.startswith(r.name + "/")), None,
+        )
+        if rep is None:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            if reason == "backend_demoted":
+                rep.demotions += 1
+                if rep.state != DRAINING:
+                    rep.state = DRAINING
+                    events.record(
+                        "replica_draining", replica=rep.name,
+                        trigger=reason, trace_id=trace_id,
+                    )
+            else:  # slow_search
+                rep.sheds += 1
+                if rep.state == UP:
+                    rep.state = SHEDDING
+                rep.shed_until = (
+                    time.monotonic() + self.config.shed_cooldown_s
+                )
+                events.record(
+                    "replica_shedding", replica=rep.name,
+                    trigger=reason, trace_id=trace_id,
+                )
+        self._publish_replica_metrics(rep)
+
+    def _maintain(self) -> None:
+        """Lazy health maintenance at each routing decision: re-admit
+        drained replicas, expire shed cooldowns."""
+        now = time.monotonic()
+        readmitted = []
+        with self._lock:
+            for rep in self._replicas:
+                if rep.state == DRAINING \
+                        and rep.service.outstanding() == 0:
+                    rep.state = UP
+                    rep.readmits += 1
+                    readmitted.append(rep)
+                elif rep.state == SHEDDING and now >= rep.shed_until:
+                    rep.state = UP
+        for rep in readmitted:
+            events.record("replica_readmitted", replica=rep.name)
+            self._publish_replica_metrics(rep)
+
+    # -- client API ----------------------------------------------------
+
+    def submit(self, request: JobRequest) -> JobHandle:
+        """Route one job to the least-outstanding healthy replica.
+
+        Draining/shedding replicas are skipped while any healthy one
+        exists; a full replica overflows to the next-best.  Raises
+        :class:`ServiceOverloaded` only when EVERY replica rejected.
+        """
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is closed to new jobs")
+        self._maintain()
+        with self._lock:
+            ranked = sorted(
+                self._replicas,
+                key=lambda r: (0 if r.state == UP else 1,
+                               r.service.outstanding(), r.index),
+            )
+            healthy = [r for r in ranked if r.state == UP]
+        # no healthy replica: degraded least-outstanding beats rejecting
+        candidates = healthy if healthy else ranked
+        last_exc: Optional[ServiceOverloaded] = None
+        for rep in candidates:
+            try:
+                handle = rep.service.submit(request)
+            except ServiceOverloaded as exc:
+                last_exc = exc
+                continue
+            with self._lock:
+                rep.routed += 1
+            self._publish_replica_metrics(rep)
+            self._publish_stats()
+            return handle
+        if healthy and len(healthy) < len(ranked):
+            # healthy tier full: overflow onto the unhealthy remainder
+            for rep in [r for r in ranked if r not in healthy]:
+                try:
+                    handle = rep.service.submit(request)
+                except ServiceOverloaded as exc:
+                    last_exc = exc
+                    continue
+                with self._lock:
+                    rep.routed += 1
+                self._publish_replica_metrics(rep)
+                self._publish_stats()
+                return handle
+        raise last_exc if last_exc is not None else ServiceOverloaded(
+            "no replica accepted the job"
+        )
+
+    def submit_all(self, requests: Sequence[JobRequest]) -> List[JobHandle]:
+        return [self.submit(r) for r in requests]
+
+    # -- observability -------------------------------------------------
+
+    def _publish_replica_metrics(self, rep: _Replica) -> None:
+        if not obs_metrics.metrics_enabled():
+            return
+        reg = obs_metrics.registry()
+        labels = {"service": self.config.name, "replica": rep.name}
+        reg.gauge("waffle_replica_outstanding", **labels).set(
+            rep.service.outstanding()
+        )
+        reg.gauge("waffle_replica_healthy", **labels).set(
+            1 if rep.state == UP else 0
+        )
+        reg.gauge("waffle_replica_routed", **labels).set(rep.routed)
+        reg.gauge("waffle_replica_demotions", **labels).set(rep.demotions)
+        reg.gauge("waffle_replica_sheds", **labels).set(rep.sheds)
+
+    def replica_stats(self) -> List[Dict]:
+        """Per-replica snapshot (the ``replicas`` table in stats
+        payloads and storm evidence)."""
+        out = []
+        with self._lock:
+            reps = list(self._replicas)
+            states = {r.name: r.state for r in reps}
+        for rep in reps:
+            svc_stats = rep.service.stats()
+            dispatch = svc_stats.get("dispatch", {})
+            out.append({
+                "replica": rep.name,
+                "state": states[rep.name],
+                "outstanding": rep.service.outstanding(),
+                "queue_depth": svc_stats.get("queue_depth", 0),
+                "routed": rep.routed,
+                "demotions": rep.demotions,
+                "sheds": rep.sheds,
+                "readmits": rep.readmits,
+                "jobs": svc_stats.get("jobs", {}),
+                "mean_batch_occupancy": dispatch.get(
+                    "mean_batch_occupancy", 0.0
+                ),
+                "ragged_mean_occupancy": dispatch.get(
+                    "ragged_mean_occupancy", 0.0
+                ),
+                "last_hold_ms": dispatch.get("last_hold_ms"),
+                "devices": (
+                    len(rep.device_set)
+                    if rep.device_set is not None else None
+                ),
+            })
+        return out
+
+    def stats(self) -> Dict:
+        """Aggregated counters plus the per-replica table."""
+        agg: Dict[str, int] = {}
+        queue_depth = 0
+        aged_pops = 0
+        per_replica = self.replica_stats()
+        for rep in self._replicas:
+            svc_stats = rep.service.stats()
+            for key, val in svc_stats.get("jobs", {}).items():
+                agg[key] = agg.get(key, 0) + int(val)
+            queue_depth += svc_stats.get("queue_depth", 0)
+            aged_pops += svc_stats.get("aged_pops", 0)
+        return {
+            "jobs": agg,
+            "queue_depth": queue_depth,
+            "aged_pops": aged_pops,
+            "replicas": per_replica,
+        }
+
+    def outstanding(self) -> int:
+        return sum(r.service.outstanding() for r in self._replicas)
+
+    def _publish_stats(self) -> None:
+        """Front-door-owned ``WAFFLE_STATS_FILE`` publication (same
+        throttle + atomic-rename contract as the single service; the
+        payload gains a top-level ``replicas`` table)."""
+        path = os.environ.get("WAFFLE_STATS_FILE", "")
+        if not path:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._stats_published_at < 0.25:
+                return
+            self._stats_published_at = now
+        stats = self.stats()
+        payload = {
+            "service": self.config.name,
+            "unix_time": time.time(),
+            "stats": stats,
+            "replicas": stats["replicas"],
+            "slo": obs_slo.snapshot(),
+            "incidents": [
+                {k: i.get(k) for k in
+                 ("seq", "reason", "trace_id", "unix_time", "path")}
+                for i in obs_flight.incidents()[-8:]
+            ],
+        }
+        if obs_metrics.metrics_enabled():
+            payload["metrics"] = obs_metrics.registry().snapshot()
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, default=repr)
+            os.replace(tmp, path)
+        except OSError:  # a broken stats sink must never fail a job
+            pass

@@ -8,6 +8,13 @@ entries totally ordered without ever comparing handles).  A full queue
 instead of blocking the submitter — under overload the caller must get
 a fast typed answer it can retry/shed on, not a stalled thread.
 
+Strict priority starves: a saturating high class would hold a queued
+low-priority job forever.  ``aging_s`` bounds that wait — when the
+OLDEST queued job has waited longer than the aging window it pops
+next regardless of class.  Within the window ordering is exactly the
+strict heap order, so latency-sensitive traffic keeps its edge and
+the aged pop only fires under sustained cross-class pressure.
+
 :class:`WorkerPool` is a fixed set of daemon threads draining the queue
 through a job-runner callable supplied by the service.  Workers are
 deliberately dumb: all lifecycle logic (skip-if-cancelled, deadline at
@@ -19,6 +26,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Callable, List, Optional
 
 from waffle_con_tpu.obs import metrics as obs_metrics
@@ -32,15 +40,22 @@ from waffle_con_tpu.serve.job import (
 class AdmissionQueue:
     """Bounded priority queue with reject-on-full backpressure."""
 
-    def __init__(self, limit: int, name: str = "consensus") -> None:
+    def __init__(self, limit: int, name: str = "consensus",
+                 aging_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         if limit < 1:
             raise ValueError("queue limit must be >= 1")
+        if aging_s is not None and aging_s <= 0:
+            raise ValueError("aging_s must be > 0 (or None to disable)")
         self.limit = limit
+        self.aging_s = aging_s
+        self._clock = clock or time.monotonic
         self._name = name
         self._cond = threading.Condition()
         self._heap: List[tuple] = []
         self._seq = 0
         self._closed = False
+        self._aged_pops = 0
 
     def _set_depth_gauge(self, depth: int) -> None:
         if obs_metrics.metrics_enabled():
@@ -65,12 +80,38 @@ class AdmissionQueue:
                 )
             heapq.heappush(
                 self._heap,
-                (-handle.request.priority, self._seq, handle),
+                (-handle.request.priority, self._seq, self._clock(),
+                 handle),
             )
             self._seq += 1
             depth = len(self._heap)
             self._cond.notify()
         self._set_depth_gauge(depth)
+
+    def _pop_entry(self) -> tuple:
+        """Heap pop with anti-starvation aging: when the oldest queued
+        entry (minimum sequence number — sequence is global arrival
+        order) has waited past ``aging_s``, it pops instead of the
+        strict-priority head.  O(n) scan + heapify, but n is bounded by
+        the admission ``limit`` and the path only triggers on an aged
+        entry."""
+        if self.aging_s is not None and len(self._heap) > 1:
+            idx = min(range(len(self._heap)),
+                      key=lambda i: self._heap[i][1])
+            entry = self._heap[idx]
+            if (self._clock() - entry[2] >= self.aging_s
+                    and entry[1] != self._heap[0][1]):
+                self._heap[idx] = self._heap[-1]
+                self._heap.pop()
+                heapq.heapify(self._heap)
+                self._aged_pops += 1
+                if obs_metrics.metrics_enabled():
+                    obs_metrics.registry().counter(
+                        "waffle_serve_aged_pops_total",
+                        service=self._name,
+                    ).inc()
+                return entry
+        return heapq.heappop(self._heap)
 
     def get(self, timeout: Optional[float] = None) -> Optional[JobHandle]:
         """Pop the best job, or ``None`` on timeout / closed-and-empty."""
@@ -80,7 +121,7 @@ class AdmissionQueue:
                     return None
                 if not self._cond.wait(timeout):
                     return None
-            _neg_prio, _seq, handle = heapq.heappop(self._heap)
+            handle = self._pop_entry()[-1]
             depth = len(self._heap)
         self._set_depth_gauge(depth)
         return handle
@@ -88,7 +129,7 @@ class AdmissionQueue:
     def drain(self) -> List[JobHandle]:
         """Remove and return every queued job (shutdown path)."""
         with self._cond:
-            handles = [h for _p, _s, h in self._heap]
+            handles = [entry[-1] for entry in self._heap]
             self._heap.clear()
         self._set_depth_gauge(0)
         return handles
@@ -96,6 +137,11 @@ class AdmissionQueue:
     def depth(self) -> int:
         with self._cond:
             return len(self._heap)
+
+    @property
+    def aged_pops(self) -> int:
+        with self._cond:
+            return self._aged_pops
 
     def close(self) -> None:
         with self._cond:

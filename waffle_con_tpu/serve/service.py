@@ -24,6 +24,7 @@ queue with ``cancel_pending=True``.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -61,6 +62,14 @@ class ServeConfig:
     * ``batch_window_s`` — how long the first dispatch of a batch waits
       for concurrent company before executing (0 disables coalescing).
     * ``max_batch`` — batch-size wait target for the window.
+    * ``adaptive_window`` — arrival-rate-predictive hold inside the
+      window cap (see :class:`BatchingDispatcher`); off = fixed window.
+    * ``aging_s`` — admission anti-starvation: the oldest queued job
+      pops regardless of priority class after waiting this long
+      (``None`` = strict priority).
+    * ``placement`` — optional
+      :class:`~waffle_con_tpu.serve.placement.PlacementPolicy` routing
+      large admitted jobs through a mesh-sharded scorer.
     """
 
     workers: int = 4
@@ -68,6 +77,9 @@ class ServeConfig:
     batch_window_s: float = 0.002
     max_batch: int = 8
     name: str = "consensus"
+    adaptive_window: bool = True
+    aging_s: Optional[float] = 0.5
+    placement: Optional[object] = None
 
     def __post_init__(self) -> None:
         if self.workers < 1:
@@ -78,6 +90,8 @@ class ServeConfig:
             raise ValueError("batch_window_s must be >= 0")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if self.aging_s is not None and self.aging_s <= 0:
+            raise ValueError("aging_s must be > 0 (or None)")
 
 
 def _build_engine(request: JobRequest):
@@ -121,15 +135,30 @@ class ConsensusService:
         self,
         config: Optional[ServeConfig] = None,
         autostart: bool = True,
+        device_set=None,
+        arena=None,
+        publish_stats: bool = True,
     ) -> None:
+        """``device_set`` pins this service's workers to one
+        :class:`~waffle_con_tpu.parallel.mesh.DeviceSet` (mesh-promoted
+        jobs shard onto that slice); ``arena`` pins ragged ganging to
+        one replica's band arena; ``publish_stats=False`` lets a
+        replicated front door own the ``WAFFLE_STATS_FILE`` output
+        instead of N replicas clobbering each other's writes."""
         self.config = config if config is not None else ServeConfig()
+        self._device_set = device_set
+        self._arena = arena
+        self._publish = publish_stats
         self._queue = AdmissionQueue(
-            self.config.queue_limit, name=self.config.name
+            self.config.queue_limit, name=self.config.name,
+            aging_s=self.config.aging_s,
         )
         self._dispatcher = BatchingDispatcher(
             window_s=self.config.batch_window_s,
             max_batch=self.config.max_batch,
             name=self.config.name,
+            adaptive_window=self.config.adaptive_window,
+            arena=arena,
         )
         self._pool = WorkerPool(
             self.config.workers, self._queue, self._run_job,
@@ -141,7 +170,7 @@ class ConsensusService:
         self._handles: List[JobHandle] = []
         self._counts = {
             "submitted": 0, "rejected": 0, "done": 0, "failed": 0,
-            "cancelled": 0, "expired": 0,
+            "cancelled": 0, "expired": 0, "mesh_placed": 0,
         }
         if autostart:
             self.start()
@@ -194,6 +223,7 @@ class ConsensusService:
             raise TypeError(
                 f"expected JobRequest, got {type(request).__name__}"
             )
+        request = self._place(request)
         with self._lock:
             if self._closed:
                 raise ServiceClosed("service is closed to new jobs")
@@ -227,6 +257,52 @@ class ConsensusService:
 
     def submit_all(self, requests: Sequence[JobRequest]) -> List[JobHandle]:
         return [self.submit(r) for r in requests]
+
+    def _place(self, request: JobRequest) -> JobRequest:
+        """Apply the configured placement policy at admission: large
+        jax-backed jobs get ``mesh_shards`` rewritten into their config
+        so backend construction shards them onto the mesh (clamped to
+        this service's device set / the cached probe).  Any placement
+        failure leaves the job on the arena path — placement is an
+        optimization, never a reason to reject work."""
+        policy = self.config.placement
+        if policy is None:
+            return request
+        try:
+            from waffle_con_tpu.parallel import mesh as par_mesh
+
+            available = (
+                len(self._device_set) if self._device_set is not None
+                else par_mesh.probe_device_count()
+            )
+            placed = policy.place(request, available)
+        except Exception:  # noqa: BLE001 - jax-less stack, probe failure
+            return request
+        if placed is None:
+            return request
+        with self._lock:
+            self._counts["mesh_placed"] += 1
+        events.record(
+            "job_placed_mesh", job_kind=request.kind,
+            reads=len(request.reads),
+            shards=placed.config.mesh_shards,
+            service=self.config.name,
+        )
+        if obs_metrics.metrics_enabled():
+            obs_metrics.registry().counter(
+                "waffle_serve_mesh_placed_total",
+                service=self.config.name,
+            ).inc()
+        return placed
+
+    def outstanding(self) -> int:
+        """Admitted-but-unfinished job count (queued + running) — the
+        replicated front door's least-outstanding routing signal."""
+        with self._lock:
+            counts = dict(self._counts)
+        finished = (counts["done"] + counts["failed"]
+                    + counts["cancelled"] + counts["expired"])
+        return max(0, counts["submitted"] - finished)
 
     # -- worker --------------------------------------------------------
 
@@ -268,8 +344,10 @@ class ConsensusService:
             ):
                 # serve scope: scorers built for this job floor their
                 # geometry to the ragged arena's pool shapes, making
-                # them gang-eligible (see ops.ragged.geometry_hint)
-                with ops_ragged.serve_scope():
+                # them gang-eligible (see ops.ragged.geometry_hint).
+                # The device-set scope pins any mesh-promoted scorer
+                # this job builds onto the service's device slice.
+                with self._device_scope(), ops_ragged.serve_scope():
                     engine = _build_engine(handle.request)
                     result = engine.consensus()
         except BaseException as exc:
@@ -284,12 +362,23 @@ class ConsensusService:
             set_scorer_decorator(previous)
             # page-table residency ends with the job: whatever scorers
             # it admitted into the band-state arena free their pages now
+            # (arena-scoped — job ids are per-service counters and
+            # collide across replicas)
             try:
-                ops_ragged.release_job(handle.job_id)
+                ops_ragged.release_job(handle.job_id, arena=self._arena)
             except Exception:  # pragma: no cover - never block teardown
                 pass
             self._dispatcher.job_finished()
             obs_trace.set_current_context(prev_ctx)
+
+    def _device_scope(self):
+        """Context pinning this worker thread to the service's device
+        set (a no-op when the service owns the whole topology)."""
+        if self._device_set is None:
+            return contextlib.nullcontext()
+        from waffle_con_tpu.parallel import mesh as par_mesh
+
+        return par_mesh.use_device_set(self._device_set)
 
     def _finalize(self, handle: JobHandle, exc: BaseException) -> None:
         if isinstance(exc, JobCancelled):
@@ -334,7 +423,7 @@ class ConsensusService:
         the live stats + SLO snapshot (throttled) so ``waffle_top`` can
         poll a serving process without a network endpoint."""
         path = os.environ.get("WAFFLE_STATS_FILE", "")
-        if not path:
+        if not path or not self._publish:
             return
         now = time.monotonic()
         with self._lock:
@@ -380,6 +469,7 @@ class ConsensusService:
         return {
             "jobs": counts,
             "queue_depth": self._queue.depth(),
+            "aged_pops": self._queue.aged_pops,
             "dispatch": self._dispatcher.stats(),
-            "ragged": ops_ragged.arena_stats(),
+            "ragged": ops_ragged.arena_stats(self._arena),
         }
