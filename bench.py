@@ -318,6 +318,15 @@ def _emit(out, perfdb_kind=None):
         breakdown = out.get("breakdown")
         if isinstance(breakdown, dict) and "run_cols" in breakdown:
             rec["run_cols"] = breakdown["run_cols"]
+        # tie-heavy records carry their headline companions so the
+        # trend table tells the whole story from one line
+        for k in ("wall_s", "steps_per_s", "gang_occupancy",
+                  "gang_commit_rate"):
+            v = out.get(k)
+            if v is None and isinstance(breakdown, dict):
+                v = breakdown.get(k)
+            if v is not None:
+                rec[k] = v
         if "phases" in out:
             rec["phases"] = out["phases"]
         path = perfdb.append_record(rec)
@@ -325,6 +334,21 @@ def _emit(out, perfdb_kind=None):
               file=sys.stderr)
     except Exception as exc:  # noqa: BLE001 - history is best-effort
         print(f"perfdb append failed: {exc!r}", file=sys.stderr)
+
+
+def _gang_fields(counters) -> dict:
+    """Frontier-gang occupancy/commit summary for an evidence breakdown."""
+    groups = counters.get("gang_groups", 0)
+    gi = counters.get("run_gang_injected", 0)
+    gm = counters.get("run_gang_mispredict", 0)
+    return {
+        "gang_groups": groups,
+        "gang_members": counters.get("gang_members", 0),
+        "gang_occupancy": round(
+            counters.get("gang_members", 0) / groups, 2
+        ) if groups else 0.0,
+        "gang_commit_rate": round(gi / (gi + gm), 4) if (gi + gm) else None,
+    }
 
 
 def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
@@ -445,6 +469,7 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
                 (counters.get("run_steps", 0) + counters.get("push_calls", 0))
                 / max(tpu_time, 1e-9)
             ),
+            **_gang_fields(counters),
             "runtime_events": _runtime_events(),
         },
     }
@@ -671,11 +696,65 @@ def bench_dual(num_reads, seq_len, error_rate, iters=5, trace_out=None):
                 / total_symbols,
                 3,
             ),
+            **_gang_fields(counters),
             "runtime_events": _runtime_events(),
         },
     }
     _obs_finish(out, tracer, trace_out, reports, slowest)
     return out
+
+
+def bench_tie_heavy(num_reads, seq_len, error_rate=0.02, iters=1,
+                    dual_seq_len=None):
+    """Tie-heavy worst case: the 2% error grid point whose cost ties
+    force the engine off the arena fast path and onto forced single-
+    step pops — exactly the geometry frontier-parallel speculation
+    exists for.  Runs the single-engine grid shape (the pre-PR 4x10000
+    record took 4615 s) plus one dual tie-heavy config, and reports
+    throughput (higher-better, gated by perf_report --check) with wall,
+    gang occupancy and gang-commit rate riding along in the record.
+
+    The gated ``value`` is nodes/s: the workload is deterministic, so
+    nodes_explored is a constant and nodes/s is exactly inverse wall —
+    but unlike wall it composes with the rolling higher-is-better
+    baseline machinery perf_report already applies to every kind.
+    """
+    outs = []
+    single = bench_single(num_reads, seq_len, error_rate, iters=iters)
+    wall = float(single["value"])
+    nodes = single["breakdown"].get("nodes_explored", 0)
+    single["metric"] = (
+        f"tie_heavy_4x{seq_len}x{num_reads}_{error_rate}"
+    )
+    single["mode"] = "tie-heavy"
+    single["wall_s"] = round(wall, 4)
+    single["value"] = round(nodes / max(wall, 1e-9), 1)
+    single["unit"] = "nodes/s"
+    single["steps_per_s"] = single["breakdown"].get("steps_per_s")
+    single["gang_occupancy"] = single["breakdown"].get("gang_occupancy")
+    single["gang_commit_rate"] = single["breakdown"].get("gang_commit_rate")
+    outs.append(single)
+
+    if dual_seq_len:
+        d = bench_dual(num_reads, dual_seq_len, error_rate, iters=iters)
+        dwall = float(d["value"])
+        dsteps = (
+            d["breakdown"].get("run_steps", 0)
+            + d["breakdown"].get("run_dual_steps", 0)
+            + d["breakdown"].get("arena_steps", 0)
+            + d["breakdown"].get("push_calls", 0)
+        )
+        d["metric"] = (
+            f"tie_heavy_dual_4x{dual_seq_len}x{num_reads}_{error_rate}"
+        )
+        d["mode"] = "tie-heavy"
+        d["wall_s"] = round(dwall, 4)
+        d["value"] = round(dsteps / max(dwall, 1e-9), 1)
+        d["unit"] = "steps/s"
+        d["gang_occupancy"] = d["breakdown"].get("gang_occupancy")
+        d["gang_commit_rate"] = d["breakdown"].get("gang_commit_rate")
+        outs.append(d)
+    return outs
 
 
 def bench_priority(num_reads, seq_len, error_rate, iters=5, trace_out=None):
@@ -1289,16 +1368,20 @@ def bench_explain(num_reads, seq_len, error_rate):
           f"{os.environ['WAFFLE_FRONTIER_SAMPLE']} pops) ==", file=err)
     print(f"{'t_s':>8s} {'pops':>7s} {'queue':>6s} {'live':>5s} "
           f"{'cost':>6s} {'gap':>5s} {'len':>6s} {'far':>6s} "
-          f"{'commit':>7s}", file=err)
+          f"{'commit':>7s} {'gangW':>5s} {'gangCR':>7s}", file=err)
     for s in frontier:
         gap = s.get("gap")
         commit = s.get("spec_commit_rate")
+        gw = s.get("gang_width")
+        gcr = s.get("gang_commit_rate")
         print(
             f"{s['t_s']:8.3f} {s['pops']:7d} {s['queue']:6d} "
             f"{s['live']:5d} {s['top_cost']:6d} "
             f"{'-' if gap is None else gap:>5} {s['top_len']:6d} "
             f"{s['farthest']:6d} "
-            f"{'-' if commit is None else f'{commit:.3f}':>7}",
+            f"{'-' if commit is None else f'{commit:.3f}':>7} "
+            f"{'-' if gw is None else gw:>5} "
+            f"{'-' if gcr is None else f'{gcr:.3f}':>7}",
             file=err,
         )
 
@@ -1604,6 +1687,20 @@ def main() -> None:
         "parity cross-check passed (the CI regression gate)",
     )
     parser.add_argument(
+        "--tie-heavy", action="store_true", dest="tie_heavy",
+        help="tie-heavy worst case: the 2%% error single-engine grid "
+        "shape (4x10000x8 full, smaller under --smoke) plus one dual "
+        "tie-heavy config; emits tie_heavy perfdb records (nodes/s "
+        "resp. steps/s, higher-better) carrying wall, gang occupancy "
+        "and gang-commit rate",
+    )
+    parser.add_argument(
+        "--assert-wall-ceiling", type=float, default=None, metavar="S",
+        dest="wall_ceiling",
+        help="with --tie-heavy: exit 1 unless every config's timed "
+        "wall <= S seconds and parity held (the CI smoke gate)",
+    )
+    parser.add_argument(
         "--serve", type=int, default=None, metavar="N",
         help="serving-throughput mode: N concurrent jobs through "
         "ConsensusService; reports jobs/s, mean batch occupancy, and "
@@ -1684,7 +1781,7 @@ def main() -> None:
     if args.platform == "cpu" and (
         args._run or args._gate or args.grid or args.dual or args.priority
         or args.serve or args.serve_mix or args.storm or args.microbench
-        or args.explain
+        or args.explain or args.tie_heavy
     ):
         _force_cpu_backend()
 
@@ -1725,6 +1822,38 @@ def main() -> None:
                     file=sys.stderr,
                 )
                 sys.exit(1)
+        return
+
+    if args.tie_heavy:
+        from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        smoke = args.smoke or os.environ.get("BENCH_SMOKE") == "1"
+        outs = bench_tie_heavy(
+            args.reads or 8,
+            args.seq_len or (600 if smoke else 10_000),
+            0.02,
+            iters=args.iters if args.iters != 5 else 1,
+            dual_seq_len=300 if smoke else 1500,
+        )
+        failures = []
+        for out in outs:
+            out["device_platform"] = _current_platform()
+            _emit(out, perfdb_kind="tie_heavy")
+            if not out["parity"]:
+                failures.append(f"{out['metric']}: parity lost")
+            if (
+                args.wall_ceiling is not None
+                and out["wall_s"] > args.wall_ceiling
+            ):
+                failures.append(
+                    f"{out['metric']}: wall {out['wall_s']}s > ceiling "
+                    f"{args.wall_ceiling}s"
+                )
+        if failures:
+            for f in failures:
+                print(f"FAIL: {f}", file=sys.stderr)
+            sys.exit(1)
         return
 
     if args.serve:

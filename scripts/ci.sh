@@ -101,6 +101,23 @@ for K in 2 5 8; do
     -k "fixture or multi_err_recovery"
 done
 
+echo "== frontier gang M-sweep smoke (deposit parity per pow2 width) =="
+# The frontier gang advances M branches through one ragged dispatch
+# and deposits consume-once injections; every member's appended bytes
+# must equal the M=1 solo run at every pow2 width (the sweep exits 1
+# on any break or unconsumed deposit).
+python scripts/ubench_jrun.py --sweep-m 200 > /dev/null
+
+echo "== tie-heavy bench smoke (frontier speculation wall gate) =="
+# The tie-heavy worst case (2% error: cost ties force the engine onto
+# forced single-step pops) is the geometry frontier-parallel
+# speculation exists for.  Smoke geometry under BENCH_SMOKE; the gate
+# asserts parity plus a generous absolute wall ceiling (timed wall
+# ~10s single + ~3s dual on a quiet 1-core host), and the emitted
+# tie_heavy records feed the rolling perfdb trend gate below.
+BENCH_SMOKE=1 python bench.py --tie-heavy --platform cpu \
+  --assert-wall-ceiling "${WAFFLE_TIE_HEAVY_CEILING_S:-120}"
+
 echo "== serve bench smoke (cross-job batching) =="
 SERVE_OUT="$(mktemp /tmp/waffle_ci_serve.XXXXXX.json)"
 trap 'rm -f "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT"' EXIT
@@ -358,7 +375,7 @@ python scripts/perf_report.py --check \
   --window "${WAFFLE_PERFDB_WINDOW:-10}" \
   --floor "$MICRO_FLOOR"
 python scripts/perf_report.py --check \
-  --kinds serve-mix,storm \
+  --kinds serve-mix,storm,tie_heavy \
   --tolerance "${WAFFLE_PERFDB_SERVE_TOLERANCE:-0.15}" \
   --window "${WAFFLE_PERFDB_WINDOW:-10}" \
   --floor "$MICRO_FLOOR"

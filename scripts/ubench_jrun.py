@@ -15,6 +15,15 @@ Two modes:
       K=1; K=8/16 measure the same within noise while compile time
       doubles per octave), and the TPU/GPU default of 4 is a
       conservative carry-over pending on-device sweeps.
+
+  python scripts/ubench_jrun.py --sweep-m [STEPS] [BAND]
+      Sweep the frontier-gang width M over {1, 2, 4, 8}: M identical
+      root branches advance through one FrontierGang dispatch, every
+      deposit is consumed by its matching ``run_extend`` call, and the
+      appended consensus of every member must equal the M=1 solo run
+      byte-for-byte (exit 1 on any break).  Emits a JSON table of
+      ganged steps/s, per-member wall, deposit/commit counts, and the
+      gang kernel's compile time per pow2 row-prefix.
 """
 import json
 import os
@@ -29,8 +38,9 @@ from waffle_con_tpu.config import CdwfaConfigBuilder
 from waffle_con_tpu.ops.jax_scorer import JaxScorer
 from waffle_con_tpu.utils.example_gen import generate_test
 
-argv = [a for a in sys.argv[1:] if a != "--sweep"]
+argv = [a for a in sys.argv[1:] if a not in ("--sweep", "--sweep-m")]
 SWEEP = "--sweep" in sys.argv[1:]
+SWEEP_M = "--sweep-m" in sys.argv[1:]
 STEPS = int(argv[0]) if len(argv) > 0 else 2000
 BAND = int(argv[1]) if len(argv) > 1 else 216
 
@@ -68,7 +78,70 @@ def timed_runs(n=3):
     return best
 
 
-if SWEEP:
+if SWEEP_M:
+    from waffle_con_tpu.ops import ragged as _ragged
+    from waffle_con_tpu.ops.jax_scorer import _run_cols
+
+    BIG = 2**31 - 1
+    MC = 64
+
+    def gang_pass(m):
+        """One gang-of-m engagement: returns (wall_s, gang_s, total
+        steps, appended list, injected delta)."""
+        hs = [sc.root(np.ones(len(reads), dtype=bool)) for _ in range(m)]
+        inj0 = sc.counters.get("run_gang_injected", 0)
+        t0 = time.perf_counter()
+        gang_s = 0.0
+        if m > 1:
+            gang = _ragged.frontier_gang_for(sc)
+            members = [
+                GangMember(hh, b"", BIG, BIG, 0, STEPS) for hh in hs
+            ]
+            gang.run(members, MC, False, cols=_run_cols())
+            gang_s = time.perf_counter() - t0
+        total_steps = 0
+        appended = []
+        for hh in hs:
+            steps, code, app, stats, _recs = sc.run_extend(
+                hh, b"", BIG, BIG, 0, MC, False, STEPS
+            )
+            stats.eds  # force the deferred-sync fetch into the window
+            total_steps += steps
+            appended.append(app)
+        wall = time.perf_counter() - t0
+        for hh in hs:
+            sc.free(hh)
+        inj = sc.counters.get("run_gang_injected", 0) - inj0
+        return wall, gang_s, total_steps, appended, inj
+
+    from waffle_con_tpu.ops.ragged import GangMember
+
+    sc.free(h)
+    rows = []
+    baseline = None
+    ok = True
+    for m in (1, 2, 4, 8):
+        compile_s, _, _, _, _ = gang_pass(m)  # warm-up compiles this P
+        wall, gang_s, steps, appended, inj = gang_pass(m)
+        if baseline is None:
+            baseline = appended[0]
+        parity = all(a == baseline for a in appended)
+        ok = ok and parity and (m == 1 or inj == m)
+        rows.append({
+            "m": m,
+            "steps_per_s": round(steps / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 4),
+            "gang_dispatch_s": round(gang_s, 4),
+            "steps_total": steps,
+            "deposits_committed": inj,
+            "compile_s": round(compile_s, 2),
+            "parity_vs_m1": parity,
+        })
+        print(f"M={m}: {rows[-1]}", file=sys.stderr)
+    print(json.dumps({"sweep_m": rows, "steps": STEPS, "band": BAND}))
+    if not ok:
+        sys.exit(1)
+elif SWEEP:
     rows = []
     baseline = None
     for k in (1, 2, 4, 8, 16):

@@ -1,0 +1,426 @@
+"""Frontier-parallel speculation: gang the top-M search branches
+through the ragged kernel.
+
+The contract under test is absolute: for EVERY gang width M — explicit
+(``WAFFLE_FRONTIER_M`` / ``frontier_width``) or adaptive — every engine
+produces results byte-identical to M=1 and to the Python oracle,
+because peer advances deposit as consume-once injections that are
+validated against the real pop's arguments and invalidated whenever
+the branch's slot mutates outside the speculated run (push / activate /
+arena / free / supervisor demotion).  The adaptive policy itself is
+pure (any width it returns is byte-safe), so it is unit-tested
+directly; the deposit seam is exercised at the scorer level where the
+invalidation hooks are observable."""
+
+import types
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+    PriorityConsensusDWFA,
+)
+from waffle_con_tpu.models.frontier import FrontierSpeculator, explicit_width
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.ops import ragged as _ragged
+from waffle_con_tpu.ops.ragged import GangMember
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+BIG = 2**31 - 1
+
+
+# ------------------------------------------------------------ workloads
+
+
+def _noisy_reads():
+    """2% noise at depth 8: pops fall off the arena fast path onto the
+    forced run_extend path where gangs launch (and commit)."""
+    _, reads = generate_test(4, 300, 8, 0.02, seed=52300)
+    return reads
+
+
+def _tie_reads(seq_len=160, n=8, flips=6, seed=41000):
+    """Exact 50/50 vote ties at `flips` positions: the queue holds a
+    deep flat frontier (gap 0) of near-tied branches throughout."""
+    rng = np.random.default_rng(seed)
+    truth, reads = generate_test(4, seq_len, n, 0.0, seed=seed + 1)
+    reads = [bytearray(r) for r in reads]
+    for pos in rng.choice(seq_len, size=flips, replace=False):
+        alt = (truth[pos] + 1 + int(rng.integers(3))) % 4
+        for i in range(n // 2):
+            reads[i][pos] = alt
+    return [bytes(r) for r in reads]
+
+
+def _dual_reads():
+    rng = np.random.default_rng(61250)
+    truth, reads1 = generate_test(4, 250, 5, 0.04, seed=61251)
+    h2 = bytearray(truth)
+    for pos in rng.choice(250, size=3, replace=False):
+        h2[pos] = (h2[pos] + 1 + int(rng.integers(3))) % 4
+    return list(reads1) + [
+        corrupt(bytes(h2), 0.04, np.random.default_rng(61252 + i))
+        for i in range(5)
+    ]
+
+
+def _chains():
+    n = 8
+    t0, level0 = generate_test(4, 60, n, 0.02, seed=71000)
+    t1a, _ = generate_test(4, 100, 1, 0.0, seed=71001)
+    t1b = bytearray(t1a)
+    t1b[50] = (t1b[50] + 1) % 4
+    t1b = bytes(t1b)
+    return [
+        [level0[i],
+         corrupt(t1a if i < n // 2 else t1b, 0.02,
+                 np.random.default_rng(71002 + i))]
+        for i in range(n)
+    ]
+
+
+def _cfg(backend, min_count=2):
+    return (
+        CdwfaConfigBuilder().backend(backend).min_count(min_count).build()
+    )
+
+
+def _run_single(backend, reads, m, monkeypatch, min_count=2):
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", str(m))
+    e = ConsensusDWFA(_cfg(backend, min_count))
+    for r in reads:
+        e.add_sequence(r)
+    res = [(c.sequence, c.scores) for c in e.consensus()]
+    return res, dict(e.last_search_stats.get("scorer_counters", {}))
+
+
+def _run_dual(backend, reads, m, monkeypatch, min_count=2):
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", str(m))
+    e = DualConsensusDWFA(_cfg(backend, min_count))
+    for r in reads:
+        e.add_sequence(r)
+    res = e.consensus()
+    return res, dict(e.last_search_stats.get("scorer_counters", {}))
+
+
+# the python oracle and the jax M=1 baseline are M-independent: compute
+# each expensive reference once per module, not once per parametrization
+_REF = {}
+
+
+def _ref(key, thunk):
+    if key not in _REF:
+        _REF[key] = thunk()
+    return _REF[key]
+
+
+# ----------------------------------------------------- width policy unit
+
+
+def test_explicit_width_env(monkeypatch):
+    monkeypatch.delenv("WAFFLE_FRONTIER_M", raising=False)
+    assert explicit_width() is None
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", "4")
+    assert explicit_width() == 4
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", "0")
+    assert explicit_width() == 1  # 0 means disabled == serial
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", "garbage")
+    assert explicit_width() is None
+
+
+def test_config_frontier_width_knob(monkeypatch):
+    monkeypatch.delenv("WAFFLE_FRONTIER_M", raising=False)
+    cfg = CdwfaConfigBuilder().frontier_width(6).build()
+    sp = FrontierSpeculator(object(), cfg)
+    assert sp.width(100, 0) == 6
+    # env wins over the config knob, and clamps to the gang capacity
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", "99")
+    sp = FrontierSpeculator(object(), cfg)
+    assert sp.width(100, 0) == FrontierSpeculator.MAX_M
+
+
+def test_config_frontier_width_validation():
+    with pytest.raises(ValueError):
+        CdwfaConfigBuilder().frontier_width(0).build()
+
+
+def test_width_policy_adaptive(monkeypatch):
+    monkeypatch.delenv("WAFFLE_FRONTIER_M", raising=False)
+    sp = FrontierSpeculator(object())
+    # thin queue: stay serial
+    assert sp.width(0, None) == 1
+    assert sp.width(3, 0) == 1
+    # positive best-vs-next gap: the next pops are not ties
+    assert sp.width(64, 2) == 1
+    # flat deep frontier: widen with depth, capped at the gang size
+    assert sp.width(4, 0) == 2
+    assert sp.width(8, 0) == 4
+    assert sp.width(16, None) == 8
+    assert sp.width(1000, 0) == FrontierSpeculator.MAX_M
+    assert sp.last_width == FrontierSpeculator.MAX_M
+
+
+def test_width_policy_cooldown(monkeypatch):
+    monkeypatch.delenv("WAFFLE_FRONTIER_M", raising=False)
+    sp = FrontierSpeculator(object())
+    # a window of resolutions with a rotten commit rate trips a cooldown
+    sp._js = types.SimpleNamespace(
+        counters={"run_gang_injected": 1, "run_gang_mispredict": 63}
+    )
+    assert sp.width(64, 0) == 1
+    assert sp._cooldown == FrontierSpeculator.COOLDOWN_POPS
+    for _ in range(FrontierSpeculator.COOLDOWN_POPS):
+        assert sp.width(64, 0) == 1
+    # cooldown expired AND the window was reset: speculation resumes
+    assert sp.width(64, 0) == FrontierSpeculator.MAX_M
+
+
+# ------------------------------------------------- deposit seam (scorer)
+
+
+def _two_root_gang(reads, max_steps=32):
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+
+    sc = JaxScorer(reads, _cfg("jax"))
+    n = len(reads)
+    h1 = sc.root(np.ones(n, dtype=bool))
+    h2 = sc.root(np.ones(n, dtype=bool))
+    gang = _ragged.frontier_gang_for(sc)
+    deposits = gang.run(
+        [
+            GangMember(h1, b"", BIG, BIG, 0, max_steps),
+            GangMember(h2, b"", BIG, BIG, 0, max_steps),
+        ],
+        2,
+        False,
+    )
+    return sc, gang, h1, h2, deposits
+
+
+def test_gang_deposit_consume_and_free():
+    """A gang deposit is consumed verbatim by the matching run_extend
+    call (injected, byte-identical to a solo run) and invalidated by
+    free() — a freed-then-reused handle can never see stale state."""
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+
+    _, reads = generate_test(4, 200, 6, 0.0, seed=81000)
+    sc, gang, h1, h2, deposits = _two_root_gang(reads)
+    assert deposits == 2
+    assert gang.pending(h1) and gang.pending(h2)
+
+    steps, code, appended, _stats, _recs = sc.run_extend(
+        h1, b"", BIG, BIG, 0, 2, False, 32
+    )
+    assert sc.counters.get("run_gang_injected", 0) == 1
+    assert not gang.pending(h1)
+
+    # reference: an identical scorer running the same call solo
+    ref = JaxScorer(reads, _cfg("jax"))
+    g = ref.root(np.ones(len(reads), dtype=bool))
+    rsteps, rcode, rappended, _s, _r = ref.run_extend(
+        g, b"", BIG, BIG, 0, 2, False, 32
+    )
+    assert (steps, code, appended) == (rsteps, rcode, rappended)
+
+    # free() drops the peer's deposit before the handle can be reused
+    sc.free(h2)
+    assert not gang.pending(h2)
+    assert gang.counters["dropped"] >= 1
+
+
+def test_gang_deposit_dropped_on_slot_mutation():
+    """Any out-of-band slot mutation (here: a push advancing the
+    branch) invalidates that branch's deposit — the held post-state is
+    stale — while untouched peers keep theirs."""
+    _, reads = generate_test(4, 200, 6, 0.0, seed=82000)
+    sc, gang, h1, h2, deposits = _two_root_gang(reads)
+    assert deposits == 2
+    first = bytes([reads[0][0]])
+    sc.push_many([(h1, first)])
+    assert not gang.pending(h1)
+    assert gang.pending(h2)
+
+
+def test_gang_deposit_mispredict_falls_back_solo():
+    """A deposit whose speculated arguments don't validate against the
+    real pop is discarded (mispredict counted) and the solo run from
+    the pristine slot returns the exact result."""
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+
+    _, reads = generate_test(4, 200, 6, 0.0, seed=83000)
+    sc, gang, h1, _h2, deposits = _two_root_gang(reads, max_steps=32)
+    assert deposits == 2
+    # real pop arrives with a TIGHTER budget than speculated: the
+    # speculated trajectory may overrun it, so validation must reject
+    steps, code, appended, _stats, _recs = sc.run_extend(
+        h1, b"", 0, 0, 0, 2, False, 32
+    )
+    assert sc.counters.get("run_gang_mispredict", 0) == 1
+    ref = JaxScorer(reads, _cfg("jax"))
+    g = ref.root(np.ones(len(reads), dtype=bool))
+    rsteps, rcode, rappended, _s, _r = ref.run_extend(
+        g, b"", 0, 0, 0, 2, False, 32
+    )
+    assert (steps, code, appended) == (rsteps, rcode, rappended)
+
+
+# ------------------------------------------------ engine parity at every M
+
+
+@pytest.mark.parametrize("m", [2, 4, 8])
+def test_single_engine_m_parity(m, monkeypatch):
+    reads = _ref("noisy_reads", _noisy_reads)
+    want = _ref(
+        "noisy_py",
+        lambda: _run_single("python", reads, 1, monkeypatch)[0],
+    )
+    base = _ref(
+        "noisy_jax1",
+        lambda: _run_single("jax", reads, 1, monkeypatch)[0],
+    )
+    got, counters = _run_single("jax", reads, m, monkeypatch)
+    assert base == want
+    assert got == base
+    if m == 4:
+        # the gang must actually fire AND commit on this geometry —
+        # parity alone could pass with speculation silently disabled
+        assert counters.get("gang_groups", 0) > 0
+        assert counters.get("run_gang_injected", 0) > 0
+
+
+@pytest.mark.parametrize("m", [2, 4])
+def test_dual_engine_m_parity(m, monkeypatch):
+    reads = _ref("dual_reads", _dual_reads)
+    want = _ref(
+        "dual_py", lambda: _run_dual("python", reads, 1, monkeypatch)[0]
+    )
+    base = _ref(
+        "dual_jax1", lambda: _run_dual("jax", reads, 1, monkeypatch)[0]
+    )
+    got, counters = _run_dual("jax", reads, m, monkeypatch)
+    assert base == want
+    assert got == base
+    if m == 4:
+        assert counters.get("gang_groups", 0) > 0
+        assert counters.get("run_gang_injected", 0) > 0
+
+
+def test_priority_engine_m_parity(monkeypatch):
+    chains = _ref("chains", _chains)
+
+    def run(backend, m):
+        monkeypatch.setenv("WAFFLE_FRONTIER_M", str(m))
+        e = PriorityConsensusDWFA(_cfg(backend))
+        for c in chains:
+            e.add_sequence_chain(c)
+        return e.consensus()
+
+    want = run("python", 1)
+    base = run("jax", 1)
+    got = run("jax", 4)
+    assert base == want
+    assert got == base
+
+
+def test_near_tie_divergence_grid(monkeypatch):
+    """Deep 50/50-tie frontiers — the geometry speculation targets and
+    the most parity-hostile one (every pop is a coin-flip ordering the
+    oracle resolves by FIFO seq): byte-identical at every M."""
+    reads = _ref("tie_reads", _tie_reads)
+    want = _ref(
+        "tie_py",
+        lambda: _run_single("python", reads, 1, monkeypatch,
+                            min_count=4)[0],
+    )
+    results = {
+        m: _run_single("jax", reads, m, monkeypatch, min_count=4)[0]
+        for m in (1, 2, 8)
+    }
+    assert results[1] == want
+    assert results[2] == results[1]
+    assert results[8] == results[1]
+
+
+def test_m_by_k_odd_composition(monkeypatch):
+    """Gang width composes with K-column speculative stepping: M=4
+    gangs advancing K=5 columns per device iteration (an odd K that
+    never divides stop steps evenly) stay byte-identical to M=1,K=1."""
+    reads = _ref("noisy_reads", _noisy_reads)
+    base = _ref(
+        "noisy_jax1",
+        lambda: _run_single("jax", reads, 1, monkeypatch)[0],
+    )
+    monkeypatch.setenv("WAFFLE_RUN_COLS", "5")
+    got, _ = _run_single("jax", reads, 4, monkeypatch)
+    assert got == base
+
+
+# ----------------------------------------------- faults / serving seams
+
+
+@pytest.mark.faultinject
+def test_supervisor_demotion_mid_gang(faults, monkeypatch):
+    """A mid-search backend demotion under fault injection: every
+    pending gang deposit dies with the demoted backend (release_scorer
+    drops them) and the migrated search finishes byte-identical."""
+    reads = _ref("noisy_reads", _noisy_reads)
+    want = _ref(
+        "noisy_py",
+        lambda: _run_single("python", reads, 1, monkeypatch)[0],
+    )
+    faults.add("timeout", backend="jax", at=5, count=None)
+    faults.add("timeout", backend="jax", at=6, count=None)
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", "4")
+    cfg = (
+        CdwfaConfigBuilder()
+        .backend("jax")
+        .min_count(2)
+        .backend_chain(("python",))
+        .dispatch_retries(1)
+        .breaker_threshold(2)
+        .retry_backoff_s(0.0)
+        .build()
+    )
+    e = ConsensusDWFA(cfg)
+    for r in reads:
+        e.add_sequence(r)
+    got = [(c.sequence, c.scores) for c in e.consensus()]
+    demotions = events.get_events("backend_demoted")
+    assert [(d["from_backend"], d["to_backend"]) for d in demotions] == [
+        ("jax", "python")
+    ]
+    assert got == want
+
+
+def test_adaptive_widens_and_collapses(monkeypatch):
+    """The acceptance contract for adaptive M, asserted through the
+    FrontierSampler flight records the engines publish: deep flat tie
+    frontiers widen past 1; thin frontiers never leave 1."""
+    monkeypatch.delenv("WAFFLE_FRONTIER_M", raising=False)
+    monkeypatch.setenv("WAFFLE_FRONTIER_SAMPLE", "1")
+
+    def widths(reads, min_count):
+        obs_flight.reset()
+        e = ConsensusDWFA(_cfg("jax", min_count))
+        for r in reads:
+            e.add_sequence(r)
+        e.consensus()
+        ws = [
+            r["gang_width"]
+            for r in obs_flight.get_recorder().records()
+            if r["kind"] == "frontier" and "gang_width" in r
+        ]
+        obs_flight.reset()
+        return ws
+
+    deep = widths(_ref("tie_reads", _tie_reads), 4)
+    assert max(deep) > 1
+    assert min(deep) == 1  # startup/tail frontiers are thin
+
+    _, thin_reads = generate_test(4, 120, 6, 0.01, seed=777)
+    thin = widths(thin_reads, 2)
+    assert thin and set(thin) == {1}

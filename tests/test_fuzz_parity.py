@@ -434,6 +434,87 @@ def test_spec_i16_single_and_dual(monkeypatch):
     assert dual_at(5) == dual_at(1)
 
 
+# ---------------------------------------------------------------------------
+# Frontier-parallel speculation (WAFFLE_FRONTIER_M): alongside each engaged
+# run the engine gangs the next-best M-1 queued branches through the ragged
+# kernel; peers' advances wait as consume-once deposits validated against
+# the real pop's arguments — the contract is BYTE-IDENTICAL results to M=1
+# for every M, on any workload shape.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_frontier_gang_fuzz(seed, monkeypatch):
+    """Random M x workload grid: noisy depths where gangs fire and
+    near-tie flips where predictions go stale mid-queue — every draw
+    must match the oracle and the M=1 run byte-for-byte."""
+    rng = np.random.default_rng(34000 + seed)
+    m = int(rng.choice([2, 4, 8]))
+    seq_len = int(rng.integers(120, 260))
+    n = int(rng.integers(6, 10))
+    er = float(rng.choice([0.02, 0.04]))
+    truth, reads = generate_test(4, seq_len, n, er, seed=35000 + seed)
+    reads = [bytearray(r) for r in reads]
+    # sprinkle exact half-ties on top of the noise so some speculated
+    # pops lose their predicted ordering (mispredict-discard coverage)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        alt = (truth[pos] + 1 + int(rng.integers(3))) % 4
+        for i in range(n // 2):
+            if pos < len(reads[i]):
+                reads[i][pos] = alt
+    reads = [bytes(r) for r in reads]
+    mc = int(rng.integers(2, max(3, n // 2)))
+
+    def run(backend, width):
+        monkeypatch.setenv("WAFFLE_FRONTIER_M", str(width))
+        e = ConsensusDWFA(_cfg(backend, np.random.default_rng(seed),
+                               min_count=mc))
+        for r in reads:
+            e.add_sequence(r)
+        return [(c.sequence, c.scores) for c in e.consensus()]
+
+    want = run("python", 1)
+    base = run("jax", 1)
+    spec = run("jax", m)
+    assert base == want
+    assert spec == base
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_frontier_gang_dual_fuzz(seed, monkeypatch):
+    """Dual-engine draws at random M: only single-side branches gang
+    (dual nodes need the paired kernel), and the result must stay
+    byte-identical to M=1 and the oracle."""
+    rng = np.random.default_rng(36000 + seed)
+    m = int(rng.choice([2, 4, 8]))
+    seq_len = int(rng.integers(140, 260))
+    half = int(rng.integers(3, 6))
+    er = float(rng.choice([0.02, 0.04]))
+    truth, reads1 = generate_test(4, seq_len, half, er, seed=37000 + seed)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=3, replace=False):
+        h2[pos] = (h2[pos] + 1 + rng.integers(3)) % 4
+    reads = list(reads1) + [
+        corrupt(bytes(h2), er, np.random.default_rng(38000 + seed * 16 + i))
+        for i in range(half)
+    ]
+
+    def run(backend, width):
+        monkeypatch.setenv("WAFFLE_FRONTIER_M", str(width))
+        e = DualConsensusDWFA(
+            _cfg(backend, np.random.default_rng(seed), min_count=2)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        return e.consensus()
+
+    want = run("python", 1)
+    base = run("jax", 1)
+    spec = run("jax", m)
+    assert base == want
+    assert spec == base
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_priority_chain_fuzz(seed):
     """Two-level chains with a level-1 split: the priority engine's

@@ -108,6 +108,15 @@ class CdwfaConfig:
     #: when those nodes are actually popped.  1 disables speculation.
     #: Framework extension beyond the reference config.
     prefetch_width: int = 16
+    #: Frontier-parallel speculation width M: alongside each popped
+    #: node's device run, gang the next best M-1 queued branches
+    #: through the ragged kernel and hold their advanced states as
+    #: consume-once deposits (byte-identical to M=1 at every M).
+    #: ``None`` (default) picks M adaptively from queue depth, cost gap
+    #: and the rolling gang-commit rate; 1 disables; the
+    #: ``WAFFLE_FRONTIER_M`` env var overrides either.  Framework
+    #: extension beyond the reference config.
+    frontier_width: Optional[int] = None
     #: Route every scorer dispatch through the fault-tolerant
     #: :class:`~waffle_con_tpu.runtime.supervisor.BackendSupervisor`
     #: (timeout, retry/backoff, mid-search backend demotion).  Implied
@@ -155,6 +164,8 @@ class CdwfaConfig:
             raise ValueError("mesh_shards requires the jax backend")
         if self.prefetch_width < 1:
             raise ValueError("prefetch_width must be >= 1")
+        if self.frontier_width is not None and self.frontier_width < 1:
+            raise ValueError("frontier_width must be >= 1")
         if self.initial_band is not None and self.initial_band < 1:
             raise ValueError("initial_band must be >= 1")
         if self.backend_chain is not None:

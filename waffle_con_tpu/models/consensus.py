@@ -30,6 +30,7 @@ from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
+from waffle_con_tpu.models.frontier import FrontierSpeculator, GangMember
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
     WavefrontScorer,
@@ -457,6 +458,7 @@ class ConsensusDWFA:
         results: List[Consensus] = []
         pops = 0
         frontier = FrontierSampler("single")
+        speculator = FrontierSpeculator(scorer, cfg)
 
         while not pqueue.is_empty():
             peak_queue_size = max(peak_queue_size, len(pqueue))
@@ -480,13 +482,24 @@ class ConsensusDWFA:
                     obs_metrics.registry().gauge(
                         "waffle_search_queue_depth", engine="single"
                     ).set(len(pqueue))
+            next_prio = pqueue.peek_priority()
+            # per-pop adaptive-width tick: the policy sees every pop's
+            # frontier (depth, best-vs-next gap), not only run-engage
+            # pops, so sampled gang_width tracks the frontier shape and
+            # cooldowns expire in real pops.  Pure policy — any value
+            # is byte-safe; gangs only launch on the engage path below.
+            gang_w = speculator.width(
+                len(pqueue),
+                (-next_prio[0]) - (-priority[0])
+                if next_prio is not None else None,
+            )
             if frontier.due(pops):
-                next_prio = pqueue.peek_priority()
                 frontier.sample(
                     pops, len(pqueue), len(tracker), -priority[0],
                     -next_prio[0] if next_prio is not None else None,
                     len(node.consensus), farthest_consensus,
                     counters=getattr(scorer, "counters", None),
+                    gang_width=gang_w,
                 )
             top_cost = -priority[0]
             top_len = len(node.consensus)
@@ -538,6 +551,9 @@ class ConsensusDWFA:
                         <= fp.arena_cre_per_event
                     )
                     and fp.run_arena is not None
+                    # a pending frontier-gang deposit is this pop's run
+                    # already paid for; the arena would drop it unspent
+                    and not speculator.pending(node.handle)
                 ):
                     arena = self._arena_attempt(
                         scorer, pqueue, node, maximum_error,
@@ -604,6 +620,17 @@ class ConsensusDWFA:
                         if maximum_error != math.inf
                         else 2**31 - 1
                     )
+                    # -- frontier-parallel speculation: alongside this
+                    # run, advance the next-best queued branches through
+                    # one ragged gang dispatch; their results wait as
+                    # consume-once deposits for their own pops
+                    if gang_w > 1:
+                        self._gang_attempt(
+                            speculator, scorer, pqueue, node, gang_w,
+                            me_budget, other_cost, other_len, max_steps,
+                            force_sym, maximum_error,
+                            cost is ConsensusCost.L2_DISTANCE,
+                        )
                     steps, _code, appended, run_stats, records = run_extend(
                         node.handle,
                         node.consensus,
@@ -709,7 +736,11 @@ class ConsensusDWFA:
                 peers = [
                     n
                     for n, _p in pqueue.peek_top(cfg.prefetch_width - 1)
+                    # a pending gang deposit is consumed by a FORCED pop;
+                    # prefetching the peer would unforce it (see
+                    # _gang_attempt), wasting the speculated run
                     if n.prefetch is None
+                    and not speculator.pending(n.handle)
                 ]
                 self._prefetch_expansions(
                     scorer, [node] + peers, in_place_first=True
@@ -923,6 +954,66 @@ class ConsensusDWFA:
         )
         ignored = sum(1 for k, _ in events if k == "discard")
         return far[0], lcon[0], explored, ignored
+
+    def _gang_attempt(
+        self,
+        speculator: FrontierSpeculator,
+        scorer: WavefrontScorer,
+        pqueue: SetPriorityQueue,
+        node: _Node,
+        gang_w: int,
+        me_budget: int,
+        other_cost: int,
+        other_len: int,
+        max_steps: int,
+        force_sym: int,
+        maximum_error: float,
+        l2: bool,
+    ) -> None:
+        """Frontier-parallel speculation: gang the in-hand node's run
+        with the next-best queued branches through one ragged dispatch.
+
+        The in-hand member carries its real call arguments (its deposit
+        is consumed by the ``run_extend`` immediately following).  Peers
+        are chosen so their own future pop will make the *forced* call
+        the speculation assumes: un-prefetched, un-reached, exactly one
+        passing symbol — the same ``_nominate`` the pop will evaluate,
+        so the forced symbol matches by determinism.  Their other-branch
+        (cost, len) is predicted from the entry peeked behind them; any
+        misprediction is caught by consumption validation, so peer
+        selection is pure commit-rate tuning, never a correctness
+        concern."""
+        cfg = self.config
+        members: List[GangMember] = []
+        if not speculator.pending(node.handle):
+            members.append(GangMember(
+                node.handle, node.consensus, me_budget, other_cost,
+                other_len, max_steps, force_sym,
+            ))
+        peeked = pqueue.peek_top(gang_w)
+        for i, (pn, pprio) in enumerate(peeked):
+            if len(members) >= gang_w:
+                break
+            if -pprio[0] > maximum_error:
+                continue  # its pop will be ignored, not run
+            if pn.prefetch is not None or speculator.pending(pn.handle):
+                continue
+            if self._reached_end(pn, cfg.allow_early_termination):
+                continue  # a reached pop is never forced
+            passing = self._nominate(scorer, pn)
+            if len(passing) != 1:
+                continue
+            if i + 1 < len(peeked):
+                nxt = peeked[i + 1][1]
+                poc, pol = -nxt[0], nxt[1]
+            else:
+                poc, pol = 2**31 - 1, 0
+            members.append(GangMember(
+                pn.handle, pn.consensus, me_budget, poc, pol,
+                max_steps, int(scorer.sym_id[passing[0]]),
+            ))
+        if len(members) >= 2:
+            speculator.gang(members, cfg.min_count, l2)
 
     def _nominate(self, scorer: WavefrontScorer, node: _Node) -> List[int]:
         """Passing extension symbols for a node — a pure function of its

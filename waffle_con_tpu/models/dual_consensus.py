@@ -21,6 +21,7 @@ from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
+from waffle_con_tpu.models.frontier import FrontierSpeculator, GangMember
 from waffle_con_tpu.models.consensus import (
     PROGRESS_LOG_INTERVAL,
     RUN_SIM_CAP,
@@ -466,6 +467,7 @@ class DualConsensusDWFA:
 
         pops = 0
         frontier = FrontierSampler("dual")
+        speculator = FrontierSpeculator(scorer, cfg)
         while not pqueue.is_empty():
             peak_queue_size = max(peak_queue_size, len(pqueue))
             while (
@@ -493,8 +495,16 @@ class DualConsensusDWFA:
                     obs_metrics.registry().gauge(
                         "waffle_search_queue_depth", engine="dual"
                     ).set(len(pqueue))
+            next_prio = pqueue.peek_priority()
+            # per-pop adaptive-width tick (pure policy, byte-safe): see
+            # the single engine — keeps sampled gang_width honest and
+            # ticks cooldowns in real pops
+            gang_w = speculator.width(
+                len(pqueue),
+                (-next_prio[0]) - (-priority[0])
+                if next_prio is not None else None,
+            )
             if frontier.due(pops):
-                next_prio = pqueue.peek_priority()
                 frontier.sample(
                     pops, len(pqueue),
                     len(single_tracker) + len(dual_tracker),
@@ -503,6 +513,7 @@ class DualConsensusDWFA:
                     node.max_consensus_length(),
                     max(farthest_single, farthest_dual),
                     counters=getattr(scorer, "counters", None),
+                    gang_width=gang_w,
                 )
             top_cost = -priority[0]
             top_len = node.max_consensus_length()
@@ -665,6 +676,9 @@ class DualConsensusDWFA:
                 and not reached_now
                 and not (node.is_dual and (node.lock1 or node.lock2))
                 and fp.run_arena is not None
+                # a pending frontier-gang deposit is this pop's run
+                # already paid for; the arena would drop it unspent
+                and not speculator.pending(node.h1)
             ):
                 arena = self._arena_attempt(
                     scorer, pqueue, node, top_cost, maximum_error,
@@ -780,6 +794,17 @@ class DualConsensusDWFA:
                                         rec_result, cfg.max_return_size,
                                     )
                         else:
+                            # frontier-parallel speculation over the
+                            # non-dual branches of the frontier (dual
+                            # nodes need the paired kernel, so only
+                            # single-side members gang)
+                            if gang_w > 1:
+                                self._gang_attempt(
+                                    speculator, scorer, pqueue, node,
+                                    gang_w, me_budget, other_cost,
+                                    other_len, max_steps, maximum_error,
+                                    l2,
+                                )
                             (steps, _code, app1, stats1,
                              run_records) = fp.run_extend(
                                 node.h1,
@@ -1331,6 +1356,63 @@ class DualConsensusDWFA:
             logger.warning("duplicate dual search node")
             tracker.remove(child.max_consensus_length())
             self._free_node(scorer, child)
+
+    def _gang_attempt(
+        self,
+        speculator: FrontierSpeculator,
+        scorer: WavefrontScorer,
+        pqueue: SetPriorityQueue,
+        node: _DualNode,
+        gang_w: int,
+        me_budget: int,
+        other_cost: int,
+        other_len: int,
+        max_steps: int,
+        maximum_error: float,
+        l2: bool,
+    ) -> None:
+        """Frontier-parallel speculation for the dual engine: gang the
+        in-hand non-dual node's run with the next-best queued NON-dual
+        branches through one ragged dispatch (dual nodes step two
+        linked branches, which the single-branch ragged kernel cannot
+        express — they keep their solo paired kernel).
+
+        The dual engine never forces a first symbol, so peers speculate
+        unforced: their deposit commits steps only while the state wins
+        the (predicted) pop, exactly the engage rule their own pop will
+        apply — see ``models/consensus.py._gang_attempt`` for the
+        validation story."""
+        cfg = self.config
+        members: List[GangMember] = []
+        if not speculator.pending(node.h1):
+            members.append(GangMember(
+                node.h1, node.consensus1, me_budget, other_cost,
+                other_len, max_steps, -1,
+            ))
+        peeked = pqueue.peek_top(gang_w)
+        for i, (pn, pprio) in enumerate(peeked):
+            if len(members) >= gang_w:
+                break
+            if pn.is_dual or -pprio[0] > maximum_error:
+                continue
+            if speculator.pending(pn.h1):
+                continue
+            specs = (
+                pn.prefetch[0] if pn.prefetch is not None
+                else self._build_specs(scorer, pn)
+            )
+            if not (len(specs) == 1 and specs[0][0] == "single"):
+                continue
+            if i + 1 < len(peeked):
+                nxt = peeked[i + 1][1]
+                poc, pol = -nxt[0], nxt[1]
+            else:
+                poc, pol = 2**31 - 1, 0
+            members.append(GangMember(
+                pn.h1, pn.consensus1, me_budget, poc, pol, max_steps, -1,
+            ))
+        if len(members) >= 2:
+            speculator.gang(members, cfg.min_count, l2)
 
     def _build_specs(
         self, scorer, node: _DualNode

@@ -1,0 +1,200 @@
+"""Frontier-parallel speculation policy (the adaptive-M brain).
+
+The engines' pop loops are serial by contract — byte-parity with the
+Python oracle is the bar — but on tie-heavy geometries the queue holds
+dozens of near-tied branches that will each be popped and advanced one
+column at a time.  :class:`FrontierSpeculator` turns that queue depth
+into device occupancy: alongside the in-hand node's ``run_extend`` it
+gangs the next-best M−1 queued branches (``SetPriorityQueue.peek_top``)
+through the same ``_j_run_ragged`` segment-reduce kernel the serving
+arena compiles.  Branches of one search share the scorer — hence band
+width — so the arena's W-equality gate holds trivially and a search
+self-gangs even outside the serving stack.
+
+Nothing here affects results: peers' post-run states are held as
+consume-once :class:`~waffle_con_tpu.ops.ragged._SpecInjected` deposits
+(no slot is touched at gang time) and consumed only after validation
+against the real pop's arguments, so every M — including adaptive —
+is byte-identical to M=1 by construction.  This module only decides
+*how wide* to speculate:
+
+* explicit: ``WAFFLE_FRONTIER_M`` env (wins) or the ``frontier_width``
+  config knob — fixed M, clamped to the gang capacity;
+* adaptive (default): collapse to 1 on thin frontiers (shallow queue,
+  or a positive best-vs-next cost gap — the next pop is not a tie, so
+  a peer's predicted arguments would rarely validate), widen with
+  queue depth on flat ones, and back off for a cooldown window when
+  the rolling gang-commit rate says predictions are not landing.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from waffle_con_tpu.ops import ragged as _ragged
+from waffle_con_tpu.ops.ragged import GangMember
+
+__all__ = ["FrontierSpeculator", "GangMember", "explicit_width"]
+
+
+def explicit_width() -> Optional[int]:
+    """The ``WAFFLE_FRONTIER_M`` override, or None when unset/garbage.
+    0/1 both mean "disabled" (M=1 is the serial search)."""
+    env = os.environ.get("WAFFLE_FRONTIER_M")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            return None
+    return None
+
+
+class FrontierSpeculator:
+    """Per-search frontier-gang launcher + adaptive width policy.
+
+    One instance per engine search (it caches the resolved device
+    scorer endpoint and a commit-rate window, both search-local).  The
+    engine asks :meth:`width` every pop with whatever frontier state is
+    already in hand — queue depth and the best-vs-next cost gap, the
+    same signals the ``FrontierSampler`` records — and, when it decides
+    to gang, hands :meth:`gang` the in-hand member plus peer
+    predictions.  ``run_extend`` then consumes the in-hand deposit
+    immediately; peers' deposits wait for their own pops.
+    """
+
+    #: hard cap = FrontierGang.G (fixed member-group capacity)
+    MAX_M = _ragged.FrontierGang.G
+    #: adaptive: don't gang queues shallower than this
+    MIN_DEPTH = 4
+    #: commit-rate window: resolutions needed before judging, the rate
+    #: below which speculation pauses, and the pause length (in pops)
+    RATE_WINDOW = 32
+    RATE_FLOOR = 0.25
+    COOLDOWN_POPS = 512
+
+    def __init__(self, scorer, config=None) -> None:
+        self.scorer = scorer
+        env = explicit_width()
+        cfg_w = getattr(config, "frontier_width", None) if config else None
+        self._explicit: Optional[int] = env if env is not None else cfg_w
+        self._js = None              # resolved JaxScorer endpoint
+        self._probe_failed = False   # scorer has no gangable endpoint
+        self._snap = (0, 0)          # (injected, mispredict) window base
+        self._cooldown = 0
+        self.last_width = 1
+        self.last_commit_rate: Optional[float] = None
+
+    # -- endpoint ------------------------------------------------------
+
+    def _endpoint(self, h: int):
+        """Resolve (once) the underlying ``JaxScorer`` that owns the
+        slots, via the same ``ragged_run_probe`` hop the serve layer
+        uses; engines on the python/native backends resolve to None and
+        never gang."""
+        if self._js is not None:
+            return self._js if h in self._js._slot_of else None
+        if self._probe_failed:
+            return None
+        probe = getattr(self.scorer, "ragged_run_probe", None)
+        ep = probe(h) if probe is not None else None
+        if ep is None:
+            self._probe_failed = True
+            return None
+        self._js = ep[0]
+        return self._js
+
+    # -- adaptive width -------------------------------------------------
+
+    def _window_rate(self) -> Optional[float]:
+        js = self._js
+        if js is None:
+            return None
+        inj = js.counters.get("run_gang_injected", 0)
+        mis = js.counters.get("run_gang_mispredict", 0)
+        di = inj - self._snap[0]
+        dm = mis - self._snap[1]
+        if di + dm <= 0:
+            return None
+        return di / (di + dm)
+
+    def width(self, queue_depth: int, gap: Optional[int]) -> int:
+        """Gang width for this pop (1 = run solo).  ``gap`` is
+        ``next_cost - top_cost`` (None when the queue holds one node).
+        Pure policy: any return value is byte-safe."""
+        if _ragged.serving_active() or not _ragged.enabled():
+            w = 1
+        elif self._explicit is not None:
+            w = max(1, min(int(self._explicit), self.MAX_M))
+        elif self._cooldown > 0:
+            self._cooldown -= 1
+            if self._cooldown == 0:
+                # window over: forget the bad stretch and re-try
+                self._reset_window()
+            w = 1
+        elif queue_depth < self.MIN_DEPTH or (gap is not None and gap > 0):
+            # thin frontier: the next pops are not ties, peer argument
+            # predictions would rarely validate — don't burn a dispatch
+            w = 1
+        else:
+            w = min(self.MAX_M, 1 << max(0, queue_depth.bit_length() - 2))
+            rate = self._window_rate()
+            self.last_commit_rate = rate
+            if rate is not None:
+                resolved = (
+                    self._js.counters.get("run_gang_injected", 0)
+                    - self._snap[0]
+                    + self._js.counters.get("run_gang_mispredict", 0)
+                    - self._snap[1]
+                )
+                if resolved >= self.RATE_WINDOW and rate < self.RATE_FLOOR:
+                    self._cooldown = self.COOLDOWN_POPS
+                    w = 1
+        self.last_width = w
+        return w
+
+    def _reset_window(self) -> None:
+        js = self._js
+        if js is not None:
+            self._snap = (
+                js.counters.get("run_gang_injected", 0),
+                js.counters.get("run_gang_mispredict", 0),
+            )
+
+    # -- gang launch ----------------------------------------------------
+
+    def gang(self, members: List[GangMember], min_count: int,
+             l2: bool) -> int:
+        """Dispatch one frontier gang (in-hand member first).  Returns
+        the deposit count (0 = nothing ganged; every member simply runs
+        solo).  Never raises."""
+        if len(members) < 2:
+            return 0
+        js = self._endpoint(members[0].h)
+        if js is None:
+            return 0
+        from waffle_con_tpu.ops import jax_scorer as _jx
+
+        gang = _ragged.frontier_gang_for(js)
+        return gang.run(members, min_count, l2, cols=_jx._run_cols())
+
+    def pending(self, h: int) -> bool:
+        """True when a consume-once deposit is waiting for ``h`` —
+        engines exclude such nodes from prefetch expansion peeks (their
+        next run is already paid for)."""
+        js = self._js
+        if js is None:
+            return False
+        gang = getattr(js, "_frontier_gang", None)
+        return gang is not None and gang.pending(h)
+
+    def commit_rate(self) -> Optional[float]:
+        """Cumulative gang-commit rate for this search's scorer."""
+        js = self._js
+        if js is None:
+            return None
+        inj = js.counters.get("run_gang_injected", 0)
+        mis = js.counters.get("run_gang_mispredict", 0)
+        if inj + mis == 0:
+            return None
+        return inj / (inj + mis)

@@ -233,6 +233,7 @@ class FrontierSampler:
         top_len: int,
         farthest: int,
         counters: Optional[Dict[str, int]] = None,
+        gang_width: Optional[int] = None,
     ) -> None:
         self._n += 1
         fields = {
@@ -264,6 +265,13 @@ class FrontierSampler:
             fields["ragged_injected"] = counters.get(
                 "run_ragged_injected", 0
             )
+            gi = counters.get("run_gang_injected", 0)
+            gm = counters.get("run_gang_mispredict", 0)
+            fields["gang_commit_rate"] = (
+                round(gi / (gi + gm), 4) if (gi + gm) else None
+            )
+        if gang_width is not None:
+            fields["gang_width"] = int(gang_width)
         obs_flight.record(
             "frontier", trace_id=obs_trace.current_trace_id(), **fields
         )

@@ -2703,6 +2703,9 @@ class JaxScorer(WavefrontScorer):
         self._free: List[int] = list(range(self._B))
         self._next_handle = 0
         self._slot_of = {}
+        #: lazily created same-search speculation gang (see
+        #: ops.ragged.FrontierGang / models.frontier)
+        self._frontier_gang = None
         #: dispatch/step counters for bench + profiling observability
         self.counters = {
             "push_calls": 0,
@@ -2769,6 +2772,7 @@ class JaxScorer(WavefrontScorer):
         """Double the band half-width and replay all branches at the new
         geometry (band values outside the old window are unknown, so the
         recorded consensus is re-scanned on device)."""
+        self._spec_drop()
         self._E *= 2
         self.counters["grow_e_events"] += 1
         self.counters["replayed_cols"] += int(self._state["clen"].max())
@@ -2794,6 +2798,7 @@ class JaxScorer(WavefrontScorer):
         self._act_host = grow(self._act_host, False)
 
     def _grow_cons(self) -> None:
+        self._spec_drop()
         self._C *= 2
         self._state = _j_grow_cons(self._state, new_c=self._C)
         self._place()
@@ -2854,9 +2859,22 @@ class JaxScorer(WavefrontScorer):
         return handles
 
     def free(self, h: int) -> None:
+        self._spec_drop(h)
         slot = self._slot_of.pop(h, None)
         if slot is not None:
             self._free.append(slot)
+
+    def _spec_drop(self, h: Optional[int] = None) -> None:
+        """Invalidate pending frontier-gang deposits: for one handle
+        when its slot is about to mutate outside the speculated run
+        (push / activate / arena / free), or for everything on a
+        geometry change (the held post-state rows are old-geometry)."""
+        gang = self._frontier_gang
+        if gang is not None:
+            if h is None:
+                gang.drop_all()
+            else:
+                gang.drop(h)
 
     def _invalidate_root_stats(self) -> None:
         """The bundled root snapshot is only valid while the branch is
@@ -2878,6 +2896,9 @@ class JaxScorer(WavefrontScorer):
         self._invalidate_root_stats()
         self.counters["push_calls"] += 1
         self.counters["push_branches"] += len(specs)
+        if self._frontier_gang is not None:
+            for h, _c in specs:
+                self._spec_drop(h)
         for _, consensus in specs:
             while len(consensus) >= self._C - 1:
                 self._grow_cons()
@@ -2933,6 +2954,7 @@ class JaxScorer(WavefrontScorer):
         for src_h, consensus, in_place in specs:
             src = self._slot_of[src_h]
             if in_place:
+                self._spec_drop(src_h)
                 handles.append(src_h)
                 dst = src
             else:
@@ -2995,6 +3017,7 @@ class JaxScorer(WavefrontScorer):
     ) -> None:
         self._invalidate_root_stats()
         self.counters["activate_calls"] += 1
+        self._spec_drop(h)
         slot = self._slot_of[h]
         self._off_host[slot, read_index] = offset
         self._act_host[slot, read_index] = True
@@ -3012,6 +3035,7 @@ class JaxScorer(WavefrontScorer):
 
     def deactivate(self, h: int, read_index: int) -> None:
         self._invalidate_root_stats()
+        self._spec_drop(h)
         slot = self._slot_of[h]
         self._act_host[slot, read_index] = False
         self._state = _j_deactivate(
@@ -3022,6 +3046,9 @@ class JaxScorer(WavefrontScorer):
         if not pairs:
             return
         self._invalidate_root_stats()
+        if self._frontier_gang is not None:
+            for h, _r in pairs:
+                self._spec_drop(h)
         npad = _next_pow2(len(pairs))
         hs = [self._slot_of[h] for h, _ in pairs]
         ridx = [r for _, r in pairs]
@@ -3191,6 +3218,87 @@ class JaxScorer(WavefrontScorer):
 
         _ragged.release_scorer(self)
 
+    def _spec_consume(
+        self, inj, h: int, consensus: bytes, me_budget: int,
+        other_cost: int, other_len: int, min_count: int, l2: bool,
+        max_steps: int, first_sym: int,
+    ) -> bool:
+        """Validate a speculative frontier-gang deposit against the
+        REAL ``run_extend`` arguments; on success scatter its held
+        post-state into the slot and return True.
+
+        Soundness: inside the run kernel only the vote decisions —
+        pure functions of band state and the search constants
+        (min_count / l2 / wildcard / early-termination) — choose WHAT
+        commits; the per-call arguments (budget, competing-pop
+        priority, step limit) only decide WHERE the run stops.
+        Stopping EARLIER than the real call would have is always exact
+        (the engine simply re-pops and continues), so consumption only
+        has to prove the real call would have committed at least
+        ``inj.steps`` columns:
+
+        * the forced step-0 commit is argument-independent (only band
+          overflow refuses it, and overflow is pure state), so a
+          forced deposit needs in-run checks only for commits past it;
+        * when the speculated (budget, other_cost, other_len) EQUAL
+          the real call's, every stop decision the kernel made is the
+          decision the real call would make — the whole run is exact
+          verbatim (the dominant case: the in-hand member always
+          carries real arguments, and near-tie peers usually predict
+          the competing priority exactly);
+        * otherwise every later commit passed ``total <= me_budget``
+          and the wins predicate at its state; totals are nondecreasing
+          over a run, so ``final_cost <= me_budget`` and the wins
+          predicate evaluated at ``(final_cost, len0 + a)`` bound every
+          intermediate check the real call would have made.  (The
+          FINAL state need not win — stopping on a lost pop is the
+          normal case — which is why the bound applies to the gating
+          states, conservatively.)
+
+        ``allow_records`` needs no gate: the ragged kernel stops at
+        reached states (records force-disabled), which is a
+        conservative early stop under a record-absorbing real call —
+        the same argument the serving-path injections rely on."""
+        if inj.len0 != len(consensus):
+            return False
+        if inj.first_sym != int(first_sym):
+            return False
+        if inj.min_count != int(min_count) or inj.l2 != bool(l2):
+            return False
+        if inj.steps > int(max_steps):
+            return False
+        a = 1 if inj.first_sym >= 0 else 0
+        if inj.steps > a:
+            me = min(int(me_budget), 2**31 - 1)
+            oc = min(int(other_cost), 2**31 - 1)
+            args_equal = (
+                inj.other_cost == oc
+                and inj.other_len == int(other_len)
+                # unequal budgets are still exact when every state fit
+                # the real one (budgets only shrink, so this is the
+                # common drift) — the win decisions were identical
+                and (inj.me_budget == me or inj.final_cost <= me)
+            )
+            if not args_equal:
+                if inj.final_cost > me:
+                    return False
+                if not (
+                    inj.final_cost < oc
+                    or (inj.final_cost == oc and inj.len0 + a > int(other_len))
+                ):
+                    return False
+        slot = self._slot_of[h]
+        D, e, rmin, er, cons, clen = inj.post
+        _note_compile("j_slot_put", tuple(
+            self._state[k].shape for k in
+            ("D", "e", "rmin", "er", "cons", "clen")
+        ))
+        self._state = _j_slot_put(
+            self._state, np.int32(slot), D, e, rmin, er, cons,
+            np.int32(clen),
+        )
+        return True
+
     def run_extend(
         self,
         h: int,
@@ -3218,12 +3326,35 @@ class JaxScorer(WavefrontScorer):
         from waffle_con_tpu.ops import ragged as _ragged
 
         inj = _ragged.take_injected(self, h)
+        if inj is not None and getattr(inj, "speculative", False):
+            # frontier-gang deposit: the slot was NOT advanced at gang
+            # time — validate the speculated call against the real
+            # arguments and scatter the held post-state only on a
+            # match.  A mismatch discards the deposit; the slot still
+            # holds the pristine pre-gang state, so the solo run below
+            # is trivially exact.
+            if self._spec_consume(
+                inj, h, consensus, me_budget, other_cost, other_len,
+                min_count, l2, max_steps, first_sym,
+            ):
+                key = "run_gang_injected"
+                self.counters[key] = self.counters.get(key, 0) + 1
+                from waffle_con_tpu.obs import metrics as _obs_metrics
+
+                if _obs_metrics.metrics_enabled():
+                    _obs_metrics.registry().counter(
+                        "waffle_frontier_gang_commits_total"
+                    ).inc()
+            else:
+                key = "run_gang_mispredict"
+                self.counters[key] = self.counters.get(key, 0) + 1
+                inj = None
         if inj is not None:
             # this exact call was precomputed by a ragged gang step (see
-            # ops.ragged.BandArena.run_group): the state is already
-            # advanced in our slot — return the deposited result through
-            # the normal contract so supervision/validation/tracing all
-            # see an ordinary run_extend
+            # ops.ragged.BandArena.run_group / FrontierGang.run): the
+            # state is (now) advanced in our slot — return the deposited
+            # result through the normal contract so supervision/
+            # validation/tracing all see an ordinary run_extend
             if inj.len0 != len(consensus):  # pragma: no cover - guard
                 raise RuntimeError(
                     "ragged injection desynchronized: precomputed at "
@@ -3422,6 +3553,8 @@ class JaxScorer(WavefrontScorer):
         constant ``min_count`` / ``imb_min`` tables (the ``min_af == 0``
         semantics)."""
         self._invalidate_root_stats()
+        self._spec_drop(h1)
+        self._spec_drop(h2)
         rec = _phases.current()
         s1 = self._slot_of[h1]
         s2 = self._slot_of[h2]
@@ -3682,6 +3815,11 @@ class JaxScorer(WavefrontScorer):
         n_live = len(node_specs)
         if not 1 <= n_live <= K:
             raise ValueError("arena takes 1..ARENA_K nodes")
+        if self._frontier_gang is not None:
+            for nh1, nh2, _nl1, _nl2 in node_specs:
+                self._spec_drop(nh1)
+                if nh2 is not None:
+                    self._spec_drop(nh2)
         kinds = []
         slots = []
         live_sides = []

@@ -273,6 +273,39 @@ class _Injected:
 
 
 @dataclass
+class _SpecInjected(_Injected):
+    """A *speculative* frontier-gang deposit (:class:`FrontierGang`).
+
+    Unlike the serving-path injections, the member's slot was NOT
+    advanced at gang time: the post-run band state rides along as host
+    rows (``post``) and is scattered into the slot only if the serial
+    pop order actually reaches the node with compatible call arguments
+    (validated in ``JaxScorer.run_extend``).  A mismatch simply
+    discards the deposit — the slot still holds the pristine pre-gang
+    state, so the solo run is trivially exact."""
+
+    speculative: bool = True
+    #: forced first symbol the speculation assumed (-1 = unforced)
+    first_sym: int = -1
+    #: total cost of the advanced state under the member's cost model —
+    #: costs are nondecreasing over a run, so this single value bounds
+    #: every in-run budget/wins check the real call would have made
+    final_cost: int = 0
+    #: speculated min_count / l2 (search constants; guarded for safety)
+    min_count: int = 0
+    l2: bool = False
+    #: the call arguments the speculation ran with — when they equal
+    #: the real pop's, the kernel's stop decisions were identical and
+    #: consumption is exact with no cost bounds at all (the bounds are
+    #: only needed to prove a MISpredicted gate never over-committed)
+    me_budget: int = 2**31 - 1
+    other_cost: int = 2**31 - 1
+    other_len: int = 0
+    #: held post-run slot rows ``(D, e, rmin, er, cons, clen)``
+    post: tuple = ()
+
+
+@dataclass
 class _Residency:
     scorer: object           # strong ref: keyed by id() while resident
     rows: np.ndarray
@@ -472,9 +505,9 @@ class BandArena:
             INF, VOTE_EPS, _cummin_rows,
         )
 
-        @partial(jax.jit, static_argnames=("A",))
+        @partial(jax.jit, static_argnames=("A", "cols"))
         def _j_run_ragged(reads, rlen, D0, e0, rmin0, er0, off, act, seg,
-                          cons0, clen0, jp, A):
+                          cons0, clen0, jp, A, cols=1):
             ROWS, W = D0.shape
             L = reads.shape[1]
             G1, C = cons0.shape
@@ -696,9 +729,21 @@ class BandArena:
                 D1, e1, rmin1, er1, cons1, clen1, steps0, code_init,
                 jnp.zeros((G1,), jnp.int32),
             )
+            if cols == 1:
+                body = substep
+            else:
+                # K-column speculation composed with the gang: attempt
+                # ``cols`` column sub-steps per device iteration.  The
+                # ``live = in_group & (code == 0)`` mask freezes every
+                # member past its stop code, so any ``cols`` is
+                # byte-identical to cols=1 (see _j_run's K contract)
+                def body(carry):
+                    return lax.fori_loop(
+                        0, cols, lambda _i, c: substep(c), carry
+                    )
             (D, e, rmin, er, cons, clen, steps, code,
              iters) = lax.while_loop(
-                lambda c: jnp.any(in_group & (c[7] == 0)), substep, init
+                lambda c: jnp.any(in_group & (c[7] == 0)), body, init
             )
             eds, occ, split, reached = stats_rows(D, e, rmin, er, clen)
             fin = jnp.maximum(e, rmin)
@@ -941,6 +986,318 @@ class BandArena:
 
 
 # ======================================================================
+# frontier gang: same-search speculation through the ragged kernel
+
+
+@dataclass
+class GangMember:
+    """One branch's speculated ``run_extend`` call for a frontier gang:
+    the in-hand node carries its real arguments; peers carry the
+    engine's *prediction* of the arguments their own future pop will
+    use (prediction quality only affects the commit rate — consumption
+    is validated against the real arguments, so any prediction is
+    byte-safe)."""
+
+    h: int
+    consensus: bytes
+    me_budget: int
+    other_cost: int
+    other_len: int
+    max_steps: int
+    first_sym: int = -1
+
+
+class FrontierGang:
+    """Same-search speculative ganging: advance the top-M branches of
+    ONE search through the shared ragged kernel in a single dispatch.
+
+    Branches of one search share the scorer — hence band width — so the
+    arena's W-equality byte-identity gate holds trivially and a search
+    self-gangs even outside the serving stack.  Member ``g`` occupies
+    pool rows ``g*R .. g*R+R-1`` over the scorer's reads tiled ``P/R``
+    times, so the exact segment-reduce kernel the serving arena
+    compiles also serves the self-gang (one extra specialization per
+    pow2 member count).  Results deposit as consume-once
+    :class:`_SpecInjected` records holding the post-run state as host
+    rows; no slot is touched at gang time, so a mispredicted member's
+    solo fallback runs from pristine state (see ``_SpecInjected``).
+
+    Single-threaded by design: the gang belongs to one search loop and
+    frontier ganging is disabled under ``serve_scope`` (the coalescing
+    dispatcher owns cross-job batching there)."""
+
+    #: fixed member-group capacity: jp/cons group shapes stay constant
+    #: so adaptive M only ladders the pow2 row-prefix compile key
+    G = 8
+
+    _build_kernel = BandArena._build_kernel
+
+    def __init__(self, scorer) -> None:
+        self.scorer = scorer
+        self._kernel = None
+        self._tiles: Dict[int, tuple] = {}   # P -> (reads_dev, rlen_dev)
+        self._reads_host = None              # (np reads, np rlen)
+        self._injected: Dict[int, _SpecInjected] = {}
+        self.counters = {
+            "groups": 0, "members": 0, "deposits": 0, "dropped": 0,
+            "occupancy_max": 0,
+        }
+
+    # -- consume-once deposits -----------------------------------------
+
+    def take(self, h: int) -> Optional[_SpecInjected]:
+        return self._injected.pop(int(h), None)
+
+    def pending(self, h: int) -> bool:
+        return int(h) in self._injected
+
+    def drop(self, h: int) -> None:
+        """Invalidate a branch's deposit: its slot mutated (push /
+        activate / arena / free) so the held post-state is stale."""
+        if self._injected.pop(int(h), None) is not None:
+            self.counters["dropped"] += 1
+
+    def drop_all(self) -> None:
+        """Invalidate everything: a geometry grow or supervisor
+        demotion obsoleted every held post-state at once."""
+        n = len(self._injected)
+        if n:
+            self._injected.clear()
+            self.counters["dropped"] += n
+
+    # -- staging -------------------------------------------------------
+
+    def _tile(self, P: int):
+        """Reads pool for row-prefix ``P``: the scorer's reads tiled to
+        fill every member block (cached per P; reads never change)."""
+        t = self._tiles.get(P)
+        if t is None:
+            import jax
+
+            sc = self.scorer
+            if self._reads_host is None:
+                self._reads_host = (
+                    np.asarray(jax.device_get(sc._reads)),
+                    np.asarray(jax.device_get(sc._rlen)),
+                )
+            reads_np, rlen_np = self._reads_host
+            reps = P // reads_np.shape[0]
+            t = (
+                jax.device_put(np.tile(reads_np, (reps, 1))),
+                jax.device_put(np.tile(rlen_np, reps)),
+            )
+            self._tiles[P] = t
+        return t
+
+    # -- gang execution ------------------------------------------------
+
+    def run(self, members: List[GangMember], min_count: int, l2: bool,
+            cols: int = 1) -> int:
+        """One gang dispatch over ``members``; deposits a speculative
+        injection per member (the engine consumes the in-hand member's
+        immediately, peers' wait for their pops).  Returns the deposit
+        count.  Never raises: any failure leaves every slot untouched
+        and the affected members simply run solo."""
+        rec = _phases.begin("frontier_gang", "jax")
+        try:
+            return self._run(members, min_count, l2, cols)
+        except Exception:  # noqa: BLE001 - speculation must never fail
+            logger.warning(
+                "frontier gang of %d failed; members fall back to solo",
+                len(members), exc_info=True,
+            )
+            return 0
+        finally:
+            _phases.end(rec)
+
+    def _run(self, members: List[GangMember], min_count: int, l2: bool,
+             cols: int) -> int:
+        import jax
+
+        from waffle_con_tpu.ops import jax_scorer as js
+
+        sc = self.scorer
+        if getattr(sc, "_shardings", None) is not None:
+            return 0  # mesh-sharded state: slot gather spans shards
+        R, W, C, A = sc._R, sc._W, sc._C, sc._A
+        G, G1 = self.G, self.G + 1
+        live0 = []
+        for m in members[:G]:
+            slot = sc._slot_of.get(m.h)
+            if slot is None or int(m.h) in self._injected:
+                continue
+            if len(m.consensus) + int(m.max_steps) + 2 >= C:
+                continue  # the solo wrapper would grow; don't speculate
+            live0.append((m, slot))
+        if len(live0) < 2:
+            return 0
+        nrows = len(live0) * R
+        P = 1
+        while P < nrows:
+            P *= 2
+
+        rec = _phases.current()
+        if rec is not None:
+            rec.annotate(
+                kernel="frontier", k=int(cols),
+                geom=f"P{P}W{W}G{len(live0)}",
+            )
+
+        # one bundled device_get: every member's full band-state rows
+        slots = np.asarray([slot for _m, slot in live0], np.int64)
+        st = sc._state
+        with _phases.transfer_scope(rec):
+            gD, ge, grmin, ger, gcons, gclen = jax.device_get((
+                st["D"][slots], st["e"][slots], st["rmin"][slots],
+                st["er"][slots], st["cons"][slots], st["clen"][slots],
+            ))
+
+        INF = int(js.INF)
+        D = np.full((P, W), INF, np.int32)
+        e = np.zeros(P, np.int32)
+        rmin = np.full(P, INF, np.int32)
+        er = np.full(P, INF, np.int32)
+        off = np.zeros(P, np.int32)
+        act = np.zeros(P, bool)
+        seg = np.full(P, G, np.int32)
+        cons = np.zeros((G1, C), np.int32)
+        clen = np.zeros(G1, np.int32)
+        jp = np.zeros((G1, _JP_COLS), np.int32)
+        cfg = sc.config
+        wc_int = (
+            sc.sym_id.get(cfg.wildcard, -2)
+            if cfg.wildcard is not None else -2
+        )
+        et_int = int(bool(cfg.allow_early_termination))
+        live = []
+        for i, (m, slot) in enumerate(live0):
+            if int(gclen[i]) != len(m.consensus):
+                continue  # engine/slot desync: solo path decides
+            g = len(live)
+            rs = slice(g * R, (g + 1) * R)
+            D[rs] = gD[i]
+            e[rs] = ge[i]
+            rmin[rs] = grmin[i]
+            er[rs] = ger[i]
+            off[rs] = sc._off_host[slot]
+            act[rs] = sc._act_host[slot]
+            seg[rs] = g
+            cons[g] = gcons[i]
+            clen[g] = int(gclen[i])
+            jp[g] = (
+                1,
+                min(int(m.me_budget), 2**31 - 1),
+                min(int(m.other_cost), 2**31 - 1),
+                int(m.other_len),
+                int(min_count),
+                int(bool(l2)),
+                int(m.max_steps),
+                int(m.first_sym),
+                int(wc_int),
+                et_int,
+            )
+            live.append(m)
+        if len(live) < 2:
+            return 0
+
+        if self._kernel is None:
+            self._kernel = _shared_kernel(self)
+        reads_t, rlen_t = self._tile(P)
+        js._note_compile(
+            "j_run_ragged", (P, W, sc._L, C, G1, A, int(cols))
+        )
+        with _phases.device_scope(rec):
+            out_dev = self._kernel(
+                reads_t, rlen_t, D, e, rmin, er, off, act, seg, cons,
+                clen, jp, A=A, cols=int(cols),
+            )
+            if rec is not None:
+                out_dev = jax.block_until_ready(out_dev)
+        with _phases.transfer_scope(rec):
+            out = jax.device_get(out_dev)
+        (oD, oe, ormin, oer, ocons, oclen, osteps, ocode, oiters,
+         oeds, oocc, osplit, oreached, ofin, ofovf) = out
+
+        for g, m in enumerate(live):
+            rs = slice(g * R, (g + 1) * R)
+            len0 = len(m.consensus)
+            steps = int(osteps[g])
+            eds_g = np.array(oeds[rs])
+            cost_rows = eds_g.astype(np.int64)
+            if l2:
+                cost_rows = cost_rows * cost_rows
+            # inactive rows carry eds 0, so a plain sum IS the kernel's
+            # segment total at the stopped state
+            final_cost = min(int(cost_rows.sum()), 2**31 - 1)
+            self._injected[int(m.h)] = _SpecInjected(
+                len0=len0,
+                steps=steps,
+                code=int(ocode[g]),
+                ids=np.array(ocons[g, len0:len0 + max(steps, 0)]),
+                stats=(
+                    eds_g, np.array(oocc[rs]), np.array(osplit[rs]),
+                    np.array(oreached[rs]), np.array(ofin[rs]),
+                    not bool(ofovf[g]),
+                ),
+                iters=int(oiters[g]),
+                first_sym=int(m.first_sym),
+                final_cost=final_cost,
+                min_count=int(min_count),
+                l2=bool(l2),
+                me_budget=min(int(m.me_budget), 2**31 - 1),
+                other_cost=min(int(m.other_cost), 2**31 - 1),
+                other_len=int(m.other_len),
+                post=(
+                    np.array(oD[rs]), np.array(oe[rs]),
+                    np.array(ormin[rs]), np.array(oer[rs]),
+                    np.array(ocons[g]), int(oclen[g]),
+                ),
+            )
+        n = len(live)
+        self.counters["groups"] += 1
+        self.counters["members"] += n
+        self.counters["deposits"] += n
+        self.counters["occupancy_max"] = max(
+            self.counters["occupancy_max"], n
+        )
+        scc = getattr(sc, "counters", None)
+        if scc is not None:
+            scc["gang_groups"] = scc.get("gang_groups", 0) + 1
+            scc["gang_members"] = scc.get("gang_members", 0) + n
+        if obs_metrics.metrics_enabled():
+            reg = obs_metrics.registry()
+            reg.gauge("waffle_frontier_gang_occupancy").set(n)
+            reg.counter("waffle_frontier_gang_deposits_total").inc(n)
+        return n
+
+    def stats(self) -> Dict:
+        c = dict(self.counters)
+        groups = c["groups"]
+        return {
+            "pending": len(self._injected),
+            "mean_occupancy": (c["members"] / groups) if groups else 0.0,
+            **c,
+        }
+
+
+def frontier_gang_for(scorer) -> FrontierGang:
+    """The scorer's lazily created frontier gang (one per scorer; lives
+    and dies with it)."""
+    gang = getattr(scorer, "_frontier_gang", None)
+    if gang is None:
+        gang = FrontierGang(scorer)
+        scorer._frontier_gang = gang
+    return gang
+
+
+def serving_active() -> bool:
+    """True inside a ``serve_scope`` — the coalescing dispatcher owns
+    batching there, so engines must not self-gang (a frontier dispatch
+    would race the cross-job ragged pass over the same slots)."""
+    return getattr(_TLS, "serving", None) is not None
+
+
+# ======================================================================
 # shared ragged kernel
 #
 # _build_kernel's jitted body closes over nothing per-instance — every
@@ -1063,6 +1420,13 @@ def run_group(specs: List[RunSpec],
 
 
 def take_injected(scorer, h: int):
+    # frontier-gang deposits first: they are search-local (same thread)
+    # and mutually exclusive with serving-path deposits by construction
+    gang = getattr(scorer, "_frontier_gang", None)
+    if gang is not None:
+        inj = gang.take(h)
+        if inj is not None:
+            return inj
     for a in _all_arenas():
         inj = a.take_injected(scorer, h)
         if inj is not None:
@@ -1079,6 +1443,11 @@ def discard_injected(keys, arena: Optional[BandArena] = None) -> None:
 
 
 def release_scorer(scorer) -> None:
+    # supervisor demotion / backend swap: every held speculative state
+    # is stale by definition (the rebuilt backend replays its ledger)
+    gang = getattr(scorer, "_frontier_gang", None)
+    if gang is not None:
+        gang.drop_all()
     for a in _all_arenas():
         a.release_scorer(scorer)
 

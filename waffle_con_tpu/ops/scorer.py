@@ -498,6 +498,12 @@ class SubsetScorer(WavefrontScorer):
         # fast_paths() snapshot taken over this view (see fast_paths)
         return getattr(self.base, "fastpath_gen", 0)
 
+    def ragged_run_probe(self, h: int):
+        # handles ARE base handles (run_extend forwards them verbatim),
+        # so ragged/frontier ganging hops straight through the view
+        inner = getattr(self.base, "ragged_run_probe", None)
+        return inner(h) if inner is not None else None
+
     def _slice(self, stats: BranchStats) -> BranchStats:
         idx = self.indices
         return BranchStats(

@@ -271,17 +271,41 @@ class SetPriorityQueue:
 
     def peek_top(self, k: int) -> List[Tuple[Any, Tuple]]:
         """Up to ``k`` best ``(item, priority)`` pairs in pop order,
-        without removing them (used for speculative expansion)."""
+        without removing them (used for speculative expansion and
+        frontier ganging).
+
+        Partial selection: the backing array is a binary heap, so the
+        next-best candidates are reachable by walking it as a tree with
+        an auxiliary frontier heap — O(k log k) comparisons per call
+        instead of the O(n log k) full scan ``heapq.nsmallest`` costs,
+        which scaled every pop with queue depth on deep tie-heavy
+        queues.  Stale entries (already popped keys) are skipped but
+        their subtrees are still expanded, since a stale parent still
+        heap-dominates its children."""
         out: List[Tuple[Any, Tuple]] = []
-        if k <= 0:
+        if k <= 0 or not self._live:
             return out
-        for _neg, _seq, key in heapq.nsmallest(k, self._heap):
-            entry = self._live.get(key)
-            if entry is None:  # pragma: no cover - defensive (no stale paths)
-                continue
-            out.append((entry[1], entry[0]))
-            if len(out) == k:
-                break
+        heap = self._heap
+        # drain stale entries off the root so repeated peeks stay cheap
+        while heap and heap[0][2] not in self._live:
+            heapq.heappop(heap)
+        if not heap:  # pragma: no cover - _live nonempty implies a root
+            return out
+        n = len(heap)
+        # (entry, index) pairs: entries order by (neg_priority, seq) and
+        # seq is unique, so comparison never reaches index or key —
+        # emission order is exactly pop order
+        frontier: List[Tuple[Tuple[Any, int, Hashable], int]] = [(heap[0], 0)]
+        while frontier and len(out) < k:
+            entry, i = heapq.heappop(frontier)
+            live = self._live.get(entry[2])
+            if live is not None:
+                out.append((live[1], live[0]))
+            left = 2 * i + 1
+            if left < n:
+                heapq.heappush(frontier, (heap[left], left))
+            if left + 1 < n:
+                heapq.heappush(frontier, (heap[left + 1], left + 1))
         return out
 
     def pop(self) -> Tuple[Any, Any]:
