@@ -501,6 +501,7 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
     import numpy as np
 
     from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.utils import envspec
     from waffle_con_tpu.ops.jax_scorer import JaxScorer, _run_cols
     from waffle_con_tpu.ops.scorer import host_overlap_total
     from waffle_con_tpu.utils.example_gen import generate_test
@@ -531,7 +532,7 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
 
     def measure(k):
         """(steps/s, parity, commit_rate, steps, code, compile_s) at K=k."""
-        prev = os.environ.get("WAFFLE_RUN_COLS")
+        prev = envspec.get_raw("WAFFLE_RUN_COLS")
         os.environ["WAFFLE_RUN_COLS"] = str(k)
         try:
             compile_start = time.perf_counter()
@@ -970,6 +971,7 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
     import numpy as np
 
     from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.utils import envspec
     from waffle_con_tpu.ops import ragged as ops_ragged
     from waffle_con_tpu.ops.jax_scorer import compile_count
     from waffle_con_tpu.serve import ConsensusService, JobRequest, ServeConfig
@@ -1000,7 +1002,7 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
     ]
 
     def run_phase(ragged_on):
-        prev = os.environ.get("WAFFLE_RAGGED")
+        prev = envspec.get_raw("WAFFLE_RAGGED")
         os.environ["WAFFLE_RAGGED"] = "1" if ragged_on else "0"
         ops_ragged.reset_arena()
         try:
@@ -1097,6 +1099,7 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
     import numpy as np
 
     from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.utils import envspec
     from waffle_con_tpu.ops import ragged as ops_ragged
     from waffle_con_tpu.ops.jax_scorer import compile_count
     from waffle_con_tpu.serve import (
@@ -1110,7 +1113,7 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
     from waffle_con_tpu.utils.example_gen import generate_test
 
     fault_spec = ""
-    if supervised and os.environ.get("WAFFLE_FAULTS"):
+    if supervised and envspec.get_raw("WAFFLE_FAULTS"):
         # defuse the env plan now; re-armed just before the timed
         # multi-replica pass (see docstring)
         fault_spec = os.environ.pop("WAFFLE_FAULTS")
@@ -1308,6 +1311,7 @@ def bench_explain(num_reads, seq_len, error_rate):
     while it happened (queue growth, cost-gap collapse, speculative
     commit-rate drops, ragged injections)."""
     from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.utils import envspec
     from waffle_con_tpu.obs import flight as obs_flight
     from waffle_con_tpu.obs import phases as obs_phases
     from waffle_con_tpu.utils.example_gen import generate_test
@@ -1365,7 +1369,7 @@ def bench_explain(num_reads, seq_len, error_rate):
         print(f"  {phase:15s} {totals[phase]:8.3f}s "
               f"({100 * totals[phase] / total_s:5.1f}%)", file=err)
     print(f"== search-frontier timeline ({len(frontier)} samples, every "
-          f"{os.environ['WAFFLE_FRONTIER_SAMPLE']} pops) ==", file=err)
+          f"{envspec.get_raw('WAFFLE_FRONTIER_SAMPLE')} pops) ==", file=err)
     print(f"{'t_s':>8s} {'pops':>7s} {'queue':>6s} {'live':>5s} "
           f"{'cost':>6s} {'gap':>5s} {'len':>6s} {'far':>6s} "
           f"{'commit':>7s} {'gangW':>5s} {'gangCR':>7s}", file=err)
@@ -1394,7 +1398,7 @@ def bench_explain(num_reads, seq_len, error_rate):
         "warmup_incl_compile_s": round(warm_s, 2),
         "n_results": len(results),
         "frontier_sample_every": int(
-            os.environ["WAFFLE_FRONTIER_SAMPLE"]
+            envspec.get_raw("WAFFLE_FRONTIER_SAMPLE")
         ),
         "frontier": frontier,
         "phase_totals": {k: round(v, 6) for k, v in totals.items()},

@@ -11,6 +11,11 @@ cd "$REPO"
 
 export JAX_PLATFORMS=cpu
 
+echo "== invariant lint (waffle_lint --strict) =="
+# blocking: all five WL rules over the whole tree, plus the README
+# env-table doc-sync check. Budget is ~1s; the gate is <10s.
+python scripts/waffle_lint.py --strict
+
 echo "== tier-1 suite (sharded) =="
 python scripts/run_suite.py "$@"
 
@@ -122,7 +127,11 @@ echo "== serve bench smoke (cross-job batching) =="
 SERVE_OUT="$(mktemp /tmp/waffle_ci_serve.XXXXXX.json)"
 trap 'rm -f "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT"' EXIT
 
-WAFFLE_METRICS=1 BENCH_SMOKE=1 \
+# WAFFLE_LOCKCHECK=1 arms the runtime lock-order checker on every lock
+# the serve stack creates (see waffle_con_tpu/analysis/lockcheck.py): an
+# acquisition-order inversion raises + flight-records instead of being a
+# latent deadlock. Same for the serve-mix and storm smokes below.
+WAFFLE_METRICS=1 BENCH_SMOKE=1 WAFFLE_LOCKCHECK=1 \
   python bench.py --serve 4 --platform cpu > "$SERVE_OUT"
 
 python - "$SERVE_OUT" <<'PY'
@@ -165,7 +174,7 @@ trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT"'
 # job to demote mid-search; the always-on flight recorder must dump a
 # self-contained incident without any tracing/metrics pipeline enabled
 WAFFLE_FAULTS="timeout:jax:*:*:2" WAFFLE_FLIGHT_DIR="$FLIGHT_DIR" \
-  BENCH_SMOKE=1 \
+  BENCH_SMOKE=1 WAFFLE_LOCKCHECK=1 \
   python bench.py --serve 4 --serve-supervised --platform cpu \
   > "$FLIGHT_OUT"
 
@@ -214,7 +223,7 @@ trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT" 
 # compile a CONSTANT number of kernels regardless of job shapes (the
 # pool geometry + pow2 row-prefix ladder bound the keys, not the
 # number of distinct job shapes)
-WAFFLE_METRICS=1 BENCH_SMOKE=1 \
+WAFFLE_METRICS=1 BENCH_SMOKE=1 WAFFLE_LOCKCHECK=1 \
   python bench.py --serve-mix 6 --platform cpu > "$MIX_OUT"
 
 python - "$MIX_OUT" <<'PY'
@@ -266,7 +275,7 @@ trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT" 
 #                             real parallel devices, where per-replica
 #                             device slices turn replication into
 #                             actual concurrency.
-WAFFLE_METRICS=1 \
+WAFFLE_METRICS=1 WAFFLE_LOCKCHECK=1 \
   python bench.py --storm 8 --replicas 4 --platform cpu > "$STORM_OUT"
 
 python - "$STORM_OUT" <<'PY'
@@ -322,7 +331,7 @@ echo "== storm shedding demo (fault-injected replica drain + reroute) =="
 #   WAFFLE_STORM_SHED_P95   p95 ceiling with one demoted replica
 #                           (default 12.0 — the demoted job finishes
 #                           on the python fallback backend)
-WAFFLE_FAULTS="timeout:jax:*:*:2" \
+WAFFLE_FAULTS="timeout:jax:*:*:2" WAFFLE_LOCKCHECK=1 \
   python bench.py --storm 8 --replicas 4 --serve-supervised \
   --platform cpu > "$SHED_OUT"
 

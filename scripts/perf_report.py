@@ -40,6 +40,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from waffle_con_tpu.obs import perfdb  # noqa: E402  (path bootstrap above)
+from waffle_con_tpu.utils import envspec  # noqa: E402
 
 
 def _fmt(v):
@@ -143,7 +144,7 @@ def main():
                         "baseline (default 0.05)")
     parser.add_argument(
         "--floor", type=float,
-        default=float(os.environ.get("WAFFLE_MICROBENCH_FLOOR", "900")),
+        default=float(envspec.get_raw("WAFFLE_MICROBENCH_FLOOR", "900")),
         help="absolute backstop floor (default: WAFFLE_MICROBENCH_FLOOR "
         "or 900, matching ci.sh's --assert-steps-floor)",
     )

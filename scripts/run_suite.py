@@ -27,7 +27,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SHARD_TIMEOUT_S = int(os.environ.get("WAFFLE_SUITE_TIMEOUT", "600"))
+SHARD_TIMEOUT_S = int(os.environ.get(  # waffle-lint: disable=WL001(stdlib-only runner: importing the package would pull jax into the shard driver)
+    "WAFFLE_SUITE_TIMEOUT", "600"))
 
 #: the tier-1 flag set (ROADMAP.md) minus the paths
 PYTEST_FLAGS = [

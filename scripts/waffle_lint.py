@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""Invariant lint driver (rules WL001-WL005) + env-table generator.
+
+Usage::
+
+    python scripts/waffle_lint.py [paths...] [--strict]
+    python scripts/waffle_lint.py --env-table [--write-readme]
+
+With no paths, lints the whole tree (``waffle_con_tpu/``, ``scripts/``,
+``bench.py``, ``conftest.py``; ``tests/`` excluded) plus the WL001
+README doc-sync check.  ``--strict`` exits 1 on any violation — the
+blocking CI entry point (see ``scripts/ci.sh``).
+
+``--env-table`` prints the markdown ``WAFFLE_*`` reference table from
+the ``utils/envspec.py`` registry; ``--write-readme`` splices it into
+README.md between the ``<!-- envspec:begin -->`` / ``<!-- envspec:end
+-->`` markers.
+
+The rule engine and the registry are loaded *standalone* (by file
+path, not package import), so this script never imports the package —
+and therefore never imports jax.  Full-tree runtime is a fraction of
+the 10 s CI budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+ENV_BEGIN = "<!-- envspec:begin -->"
+ENV_END = "<!-- envspec:end -->"
+
+
+def _load(module_name: str, relpath: str):
+    spec = importlib.util.spec_from_file_location(
+        module_name, REPO / relpath
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[module_name] = module  # dataclasses need the entry
+    spec.loader.exec_module(module)
+    return module
+
+
+lint = _load("_waffle_lint_rules", "waffle_con_tpu/analysis/lint.py")
+envspec = _load("_waffle_envspec", "waffle_con_tpu/utils/envspec.py")
+
+
+def _splice_readme(readme: Path, table: str) -> bool:
+    text = readme.read_text()
+    try:
+        head, rest = text.split(ENV_BEGIN, 1)
+        _old, tail = rest.split(ENV_END, 1)
+    except ValueError:
+        print(f"error: {readme} lacks {ENV_BEGIN}/{ENV_END} markers",
+              file=sys.stderr)
+        return False
+    new = f"{head}{ENV_BEGIN}\n{table}\n{ENV_END}{tail}"
+    if new != text:
+        readme.write_text(new)
+        print(f"updated {readme}")
+    else:
+        print(f"{readme} already up to date")
+    return True
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="waffle_con_tpu invariant lint (WL001-WL005)"
+    )
+    parser.add_argument("paths", nargs="*", help="files to lint "
+                        "(default: the whole tree + doc-sync)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit nonzero on any violation")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset, e.g. "
+                        "WL001,WL005")
+    parser.add_argument("--env-table", action="store_true",
+                        help="print the WAFFLE_* env reference table")
+    parser.add_argument("--write-readme", action="store_true",
+                        help="with --env-table: splice the table into "
+                        "README.md between the envspec markers")
+    args = parser.parse_args(argv)
+
+    if args.env_table:
+        table = envspec.env_table_markdown()
+        if args.write_readme:
+            return 0 if _splice_readme(REPO / "README.md", table) else 1
+        print(table)
+        return 0
+
+    rules = args.rules.split(",") if args.rules else None
+    started = time.monotonic()
+    violations = []
+    if args.paths:
+        for raw in args.paths:
+            path = Path(raw).resolve()
+            root = REPO if REPO in path.parents else None
+            violations.extend(lint.lint_path(path, root=root,
+                                             rules=rules))
+    else:
+        violations.extend(lint.lint_tree(REPO, rules=rules))
+        if rules is None or "WL001" in rules:
+            readme = REPO / "README.md"
+            if readme.exists():
+                violations.extend(lint.check_env_docs(
+                    readme.read_text(), envspec.KNOBS, "README.md"
+                ))
+    elapsed = time.monotonic() - started
+
+    for violation in violations:
+        print(violation.render())
+    count = len(violations)
+    status = "FAIL" if (violations and args.strict) else "ok"
+    print(f"waffle-lint: {count} violation(s), "
+          f"{len(lint.iter_python_files(REPO)) if not args.paths else len(args.paths)} "
+          f"file(s), {elapsed:.2f}s [{status}]")
+    return 1 if (violations and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,7 +159,7 @@ def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
         "stats_file", nargs="?",
-        default=os.environ.get("WAFFLE_STATS_FILE", ""),
+        default=os.environ.get("WAFFLE_STATS_FILE", ""),  # waffle-lint: disable=WL001(stdlib-only viewer: must not import the package, i.e. jax, just to read a path)
         help="stats JSON written by the service (WAFFLE_STATS_FILE)",
     )
     parser.add_argument("--interval", type=float, default=1.0)

@@ -1,0 +1,304 @@
+"""Lint-engine contract tests: each rule against positive/negative
+fixture snippets, the disable-comment escape hatch, the doc-sync check,
+and the gate the CI step relies on — a full-tree run with all five
+rules active reporting zero violations inside the 10 s budget.
+"""
+
+import time
+from pathlib import Path
+
+import pytest
+
+from waffle_con_tpu.analysis import lint
+from waffle_con_tpu.utils import envspec
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------
+# WL001 env-registry
+
+
+def test_wl001_flags_direct_reads():
+    src = (
+        'import os\n'
+        'a = os.environ.get("WAFFLE_METRICS")\n'
+        'b = os.getenv("WAFFLE_TRACE", "")\n'
+        'c = os.environ["WAFFLE_PROFILE"]\n'
+        'd = "WAFFLE_FAULTS" in os.environ\n'
+    )
+    v = lint.lint_source(src, "waffle_con_tpu/obs/x.py", rules=["WL001"])
+    assert rules_of(v) == ["WL001"] * 4
+    assert [x.line for x in v] == [2, 3, 4, 5]
+
+
+def test_wl001_allows_writes_registry_and_foreign_keys():
+    src = (
+        'import os\n'
+        'os.environ.setdefault("WAFFLE_RUN_COLS", "1")\n'
+        'os.environ["WAFFLE_RAGGED"] = "0"\n'
+        'os.environ.pop("WAFFLE_FAULTS", None)\n'
+        'x = os.environ.get("JAX_PLATFORMS")\n'
+        'from waffle_con_tpu.utils import envspec\n'
+        'y = envspec.get_raw("WAFFLE_TRACE")\n'
+    )
+    assert lint.lint_source(src, "waffle_con_tpu/obs/x.py",
+                            rules=["WL001"]) == []
+
+
+def test_wl001_envspec_itself_exempt():
+    src = 'import os\nx = os.environ.get("WAFFLE_TRACE")\n'
+    assert lint.lint_source(src, "waffle_con_tpu/utils/envspec.py",
+                            rules=["WL001"]) == []
+
+
+def test_wl001_doc_sync_both_directions():
+    v = lint.check_env_docs("mentions WAFFLE_TRACE only",
+                            ["WAFFLE_TRACE", "WAFFLE_METRICS"])
+    assert len(v) == 1 and "WAFFLE_METRICS" in v[0].message
+    v = lint.check_env_docs("WAFFLE_TRACE and WAFFLE_GHOST",
+                            ["WAFFLE_TRACE"])
+    assert len(v) == 1 and "WAFFLE_GHOST" in v[0].message
+    assert lint.check_env_docs("WAFFLE_TRACE", ["WAFFLE_TRACE"]) == []
+
+
+# ---------------------------------------------------------------------
+# WL002 sync-at-seam
+
+
+def test_wl002_flags_unsanctioned_sync():
+    src = (
+        'import jax\n'
+        'def pop_loop(dev):\n'
+        '    out = jax.device_get(dev)\n'
+        '    jax.block_until_ready(dev)\n'
+        '    n = dev.item()\n'
+    )
+    v = lint.lint_source(src, "waffle_con_tpu/models/engine.py",
+                        rules=["WL002"])
+    assert rules_of(v) == ["WL002"] * 3
+
+
+def test_wl002_sanctioned_scopes_and_out_of_scope_files():
+    src = (
+        'import jax\n'
+        'def pop_loop(dev, rec):\n'
+        '    with _phases.transfer_scope(rec):\n'
+        '        out = jax.device_get(dev)\n'
+        '    with _phases.device_scope(rec):\n'
+        '        jax.block_until_ready(dev)\n'
+        'class DeferredStats:\n'
+        '    def resolve(self, dev):\n'
+        '        return jax.device_get(dev)\n'
+    )
+    assert lint.lint_source(src, "waffle_con_tpu/ops/ragged.py",
+                            rules=["WL002"]) == []
+    # same sync calls, but the file is outside the rule's scope
+    bare = 'import jax\nx = jax.device_get(1)\n'
+    assert lint.lint_source(bare, "waffle_con_tpu/ops/jax_scorer.py",
+                            rules=["WL002"]) == []
+    assert len(lint.lint_source(bare, "waffle_con_tpu/models/m.py",
+                                rules=["WL002"])) == 1
+
+
+# ---------------------------------------------------------------------
+# WL003 mutation-hook completeness
+
+
+WL003_PATH = "waffle_con_tpu/ops/jax_scorer.py"
+
+
+def test_wl003_flags_unhooked_writer():
+    src = (
+        'class JaxScorer:\n'
+        '    def free(self, h):\n'
+        '        self._state[h] = None\n'
+    )
+    v = lint.lint_source(src, WL003_PATH, rules=["WL003"])
+    assert rules_of(v) == ["WL003"]
+    assert v[0].line == 2  # anchored at the def line
+
+
+def test_wl003_hooked_writer_init_and_other_classes_clean():
+    src = (
+        'class JaxScorer:\n'
+        '    def __init__(self):\n'
+        '        self._state = []\n'
+        '    def free(self, h):\n'
+        '        self._state[h] = None\n'
+        '        self._spec_drop(h)\n'
+        '    def stats(self, h):\n'
+        '        return self._state[h]\n'
+        'class Other:\n'
+        '    def free(self, h):\n'
+        '        self._state[h] = None\n'
+    )
+    assert lint.lint_source(src, WL003_PATH, rules=["WL003"]) == []
+
+
+def test_wl003_def_line_disable_covers_method():
+    src = (
+        'class JaxScorer:\n'
+        '    def root(self):  # waffle-lint: disable=WL003(fresh slot)\n'
+        '        self._off_host[0] = 1\n'
+    )
+    assert lint.lint_source(src, WL003_PATH, rules=["WL003"]) == []
+
+
+# ---------------------------------------------------------------------
+# WL004 traced-purity
+
+
+def test_wl004_flags_impurity_in_traced_bodies():
+    src = (
+        'import time, jax\n'
+        '@jax.jit\n'
+        'def step(x):\n'
+        '    t = time.perf_counter()\n'
+        '    print(x)\n'
+        '    return x\n'
+        'def body(c):\n'
+        '    return random.random()\n'
+        'def run(c):\n'
+        '    return lax.while_loop(lambda c: True, body, c)\n'
+    )
+    v = lint.lint_source(src, "waffle_con_tpu/ops/kern.py",
+                        rules=["WL004"])
+    msgs = " ".join(x.message for x in v)
+    assert rules_of(v) == ["WL004"] * 3
+    assert "time.perf_counter" in msgs and "print" in msgs \
+        and "random.random" in msgs
+
+
+def test_wl004_untraced_and_out_of_scope_clean():
+    src = (
+        'import time\n'
+        'def host_side(x):\n'
+        '    return time.perf_counter()\n'
+    )
+    assert lint.lint_source(src, "waffle_con_tpu/ops/kern.py",
+                            rules=["WL004"]) == []
+    traced = (
+        'import time, jax\n'
+        '@jax.jit\n'
+        'def step(x):\n'
+        '    return time.time()\n'
+    )
+    assert lint.lint_source(traced, "waffle_con_tpu/serve/s.py",
+                            rules=["WL004"]) == []
+
+
+# ---------------------------------------------------------------------
+# WL005 bare-thread/bare-lock
+
+
+def test_wl005_flags_bare_primitives():
+    src = (
+        'import threading\n'
+        'from threading import RLock\n'
+        'a = threading.Lock()\n'
+        'b = RLock()\n'
+        'c = threading.Thread(target=print)\n'
+    )
+    v = lint.lint_source(src, "waffle_con_tpu/serve/x.py",
+                        rules=["WL005"])
+    assert rules_of(v) == ["WL005"] * 3
+
+
+def test_wl005_wrappers_and_lockcheck_itself_clean():
+    src = (
+        'from waffle_con_tpu.analysis import lockcheck\n'
+        'a = lockcheck.make_lock("serve.x")\n'
+        'b = lockcheck.make_rlock("serve.y")\n'
+        't = lockcheck.make_thread(target=print)\n'
+        'cond = threading.Condition()\n'  # not a covered primitive
+    )
+    assert lint.lint_source(src, "waffle_con_tpu/serve/x.py",
+                            rules=["WL005"]) == []
+    bare = 'import threading\nmu = threading.Lock()\n'
+    assert lint.lint_source(
+        bare, "waffle_con_tpu/analysis/lockcheck.py", rules=["WL005"]
+    ) == []
+
+
+# ---------------------------------------------------------------------
+# disable-comment mechanics
+
+
+def test_disable_requires_reason_and_matching_rule():
+    flagged = 'import threading\nmu = threading.Lock()  # waffle-lint: disable=WL005()\n'
+    assert len(lint.lint_source(flagged, "waffle_con_tpu/a.py",
+                                rules=["WL005"])) == 1
+    wrong_rule = 'import threading\nmu = threading.Lock()  # waffle-lint: disable=WL001(reason)\n'
+    assert len(lint.lint_source(wrong_rule, "waffle_con_tpu/a.py",
+                                rules=["WL005"])) == 1
+    ok = 'import threading\nmu = threading.Lock()  # waffle-lint: disable=WL005(graph mutex)\n'
+    assert lint.lint_source(ok, "waffle_con_tpu/a.py",
+                            rules=["WL005"]) == []
+
+
+def test_disable_multiple_rules_on_one_line():
+    src = (
+        'import os, threading\n'
+        'x = os.environ.get("WAFFLE_TRACE") or threading.Lock()'
+        '  # waffle-lint: disable=WL001(fixture),WL005(fixture)\n'
+    )
+    assert lint.lint_source(src, "waffle_con_tpu/a.py") == []
+
+
+def test_syntax_error_reported_not_raised():
+    v = lint.lint_source("def broken(:\n", "waffle_con_tpu/a.py")
+    assert rules_of(v) == ["WL000"]
+
+
+# ---------------------------------------------------------------------
+# the full-tree gate
+
+
+def test_full_tree_zero_violations_within_budget():
+    started = time.monotonic()
+    violations = lint.lint_tree(REPO)
+    violations += lint.check_env_docs(
+        (REPO / "README.md").read_text(), envspec.KNOBS, "README.md"
+    )
+    elapsed = time.monotonic() - started
+    assert violations == [], "\n".join(v.render() for v in violations)
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s, budget is 10s"
+
+
+def test_tree_scan_covers_the_expected_roots():
+    files = {str(p.relative_to(REPO)) for p in lint.iter_python_files(REPO)}
+    assert "bench.py" in files and "conftest.py" in files
+    assert "waffle_con_tpu/ops/jax_scorer.py" in files
+    assert "scripts/waffle_lint.py" in files
+    assert not any(f.startswith("tests/") for f in files)
+
+
+def test_env_table_lists_every_knob():
+    table = envspec.env_table_markdown()
+    for knob in envspec.knobs():
+        assert f"`{knob.name}`" in table
+
+
+def test_envspec_rejects_unregistered_names():
+    with pytest.raises(KeyError):
+        envspec.get_raw("WAFFLE_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        envspec.flag("WAFFLE_NOT_A_KNOB")
+
+
+def test_envspec_typed_getters(monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED_ROWS", "7")
+    assert envspec.get_int("WAFFLE_RAGGED_ROWS", 256, 16, 65536) == 16
+    monkeypatch.setenv("WAFFLE_RAGGED_ROWS", "garbage")
+    assert envspec.get_int("WAFFLE_RAGGED_ROWS", 256, 16, 65536) == 256
+    monkeypatch.setenv("WAFFLE_SLO_K", "2.5")
+    assert envspec.get_float("WAFFLE_SLO_K", 3.0) == 2.5
+    monkeypatch.setenv("WAFFLE_METRICS", "0")
+    assert not envspec.flag("WAFFLE_METRICS")
+    monkeypatch.setenv("WAFFLE_METRICS", "1")
+    assert envspec.flag("WAFFLE_METRICS")

@@ -1,0 +1,9 @@
+"""Static analysis (invariant lint) + runtime lock-order checking.
+
+* :mod:`waffle_con_tpu.analysis.lint` — the AST rule engine behind
+  ``scripts/waffle_lint.py`` (rules WL001-WL005).
+* :mod:`waffle_con_tpu.analysis.lockcheck` — instrumented
+  ``Lock``/``RLock``/``Thread`` factories; with ``WAFFLE_LOCKCHECK=1``
+  they record per-thread acquisition chains and raise on a cyclic
+  lock order (potential deadlock inversion).
+"""

@@ -1,0 +1,516 @@
+"""AST-based invariant lint for the waffle_con_tpu tree.
+
+The codebase runs on conventions that no generic linter knows about;
+each rule here machine-enforces one of them:
+
+=====  ================================================================
+WL001  env-registry: every ``os.environ``/``getenv`` *read* of a
+       literal ``WAFFLE_*`` key must go through
+       ``waffle_con_tpu/utils/envspec.py`` (the registry), and the
+       registry must stay doc-synced with the README reference table.
+       Writes (``setdefault``/``pop``/assignment) stay direct — tests
+       and benches legitimately mutate the environment.
+WL002  sync-at-seam: no ``device_get`` / ``block_until_ready`` /
+       ``.item()`` in ``models/*`` or the ``ops/ragged.py`` gang
+       paths outside the sanctioned ``device_scope`` /
+       ``transfer_scope`` / ``DeferredStats`` seams.
+WL003  mutation-hook completeness: every method of a declared
+       engine class that writes a slot-tracked field must call the
+       ``_SpecInjected`` drop hook (deposit invalidation; the PR-10
+       contract).
+WL004  traced-purity: no ``time.*`` / ``random.*`` / ``print`` inside
+       ``@jax.jit`` or ``while_loop``-family bodies in ``ops/``.
+WL005  bare-thread/bare-lock: ``threading.Lock`` / ``RLock`` /
+       ``Thread`` instances must come from the instrumented
+       ``analysis.lockcheck`` factories, so the runtime lock-order
+       checker sees every lock.
+=====  ================================================================
+
+Escape hatch: ``# waffle-lint: disable=WL00N(reason)`` on the
+flagged line (comma-separate multiple rules; the reason is mandatory —
+an empty reason does not suppress).  For WL003 the violation anchors at
+the method's ``def`` line, so a disable there covers the whole method.
+
+This module is deliberately stdlib-only (``ast``/``re``/``pathlib``)
+so ``scripts/waffle_lint.py`` can load it standalone without importing
+the package (and therefore without importing jax) — full-tree runtime
+stays far under the 10 s CI budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Violation", "RULES", "lint_source", "lint_path", "lint_tree",
+    "check_env_docs", "iter_python_files",
+]
+
+RULES = ("WL001", "WL002", "WL003", "WL004", "WL005")
+
+#: inline escape hatch; reason is mandatory (empty -> no suppression)
+_DISABLE_RE = re.compile(
+    r"#\s*waffle-lint:\s*disable=([^#]*)"
+)
+_DISABLE_ITEM_RE = re.compile(r"(WL\d{3})\(([^()]*)\)")
+
+#: WL003 declaration: (path suffix, class) -> (tracked fields, hooks).
+#: A method that writes any tracked field must call one of the hooks
+#: (``__init__`` is exempt: there is nothing deposited to drop yet).
+SLOT_SPECS: Dict[Tuple[str, str], Tuple[Set[str], Set[str]]] = {
+    ("ops/jax_scorer.py", "JaxScorer"): (
+        {"_state", "_off_host", "_act_host"},
+        {"_spec_drop", "_spec_consume"},
+    ),
+}
+
+#: WL002 scope: models/* always; plus these specific ops files
+_WL002_OPS_FILES = ("ops/ragged.py",)
+_WL002_SYNC_ATTRS = {"device_get", "block_until_ready", "item"}
+_WL002_SANCTIONED_SCOPES = {"device_scope", "transfer_scope"}
+_WL002_SANCTIONED_CLASSES = {"DeferredStats"}
+
+_WL004_LOOP_FUNCS = {"while_loop", "fori_loop", "scan", "cond", "switch"}
+
+#: files that ARE the sanctioned seam a rule enforces
+_WL001_EXEMPT_SUFFIXES = ("utils/envspec.py",)
+_WL005_EXEMPT_SUFFIXES = ("analysis/lockcheck.py",)
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------
+# disable-comment handling
+
+
+def _disabled_rules(line_text: str) -> Dict[str, str]:
+    """``{rule: reason}`` for a line's disable comment (empty-reason
+    entries are dropped — a reason is mandatory)."""
+    m = _DISABLE_RE.search(line_text)
+    if not m:
+        return {}
+    return {
+        rule: reason.strip()
+        for rule, reason in _DISABLE_ITEM_RE.findall(m.group(1))
+        if reason.strip()
+    }
+
+
+def _filter_disabled(
+    violations: List[Violation], lines: Sequence[str]
+) -> List[Violation]:
+    out = []
+    for v in violations:
+        text = lines[v.line - 1] if 0 < v.line <= len(lines) else ""
+        if v.rule in _disabled_rules(text):
+            continue
+        out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------
+# shared AST helpers
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _ancestors(node: ast.AST, parents: Dict[ast.AST, ast.AST]):
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def _call_name(func: ast.AST) -> str:
+    """Trailing name of a call target: ``a.b.c(...)`` -> ``c``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain, else ``""``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_environ(node: ast.AST) -> bool:
+    return _dotted(node) in ("os.environ", "environ")
+
+
+def _literal_waffle_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value.startswith("WAFFLE_"):
+            return node.value
+    return None
+
+
+# ---------------------------------------------------------------------
+# WL001 env-registry
+
+
+def _check_wl001(path: str, tree: ast.AST,
+                 parents: Dict[ast.AST, ast.AST]) -> List[Violation]:
+    if path.endswith(_WL001_EXEMPT_SUFFIXES):
+        return []
+    out: List[Violation] = []
+
+    def flag(node: ast.AST, key: str, how: str) -> None:
+        out.append(Violation(
+            "WL001", path, node.lineno,
+            f"direct env read of {key} via {how}; use "
+            f"waffle_con_tpu.utils.envspec (get_raw/flag/get_int/"
+            f"get_float)",
+        ))
+
+    for node in ast.walk(tree):
+        # os.environ.get("WAFFLE_X") / os.getenv("WAFFLE_X")
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = _call_name(func)
+            if name == "get" and isinstance(func, ast.Attribute) \
+                    and _is_environ(func.value) and node.args:
+                key = _literal_waffle_key(node.args[0])
+                if key:
+                    flag(node, key, "environ.get")
+            elif name == "getenv" and node.args:
+                target = _dotted(func)
+                if target in ("os.getenv", "getenv"):
+                    key = _literal_waffle_key(node.args[0])
+                    if key:
+                        flag(node, key, "getenv")
+        # os.environ["WAFFLE_X"] in Load context (reads only)
+        elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+            if isinstance(node.ctx, ast.Load):
+                key = _literal_waffle_key(node.slice)
+                if key:
+                    flag(node, key, "environ[...]")
+        # "WAFFLE_X" in os.environ
+        elif isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn))
+                   for op in node.ops) and node.comparators \
+                    and _is_environ(node.comparators[0]):
+                key = _literal_waffle_key(node.left)
+                if key:
+                    flag(node, key, "membership test")
+    return out
+
+
+def check_env_docs(readme_text: str,
+                   registered: Iterable[str],
+                   readme_path: str = "README.md") -> List[Violation]:
+    """WL001 doc-sync: registry <-> README, both directions."""
+    registered = set(registered)
+    mentioned = set(re.findall(r"\bWAFFLE_[A-Z0-9_]+", readme_text))
+    out: List[Violation] = []
+    for name in sorted(registered - mentioned):
+        out.append(Violation(
+            "WL001", readme_path, 1,
+            f"registered knob {name} is missing from the README "
+            f"reference table (run scripts/waffle_lint.py --env-table)",
+        ))
+    for name in sorted(mentioned - registered):
+        out.append(Violation(
+            "WL001", readme_path, 1,
+            f"README documents {name} but it is not registered in "
+            f"utils/envspec.py (stale doc, or register the knob)",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# WL002 sync-at-seam
+
+
+def _wl002_in_scope(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if "/models/" in norm and norm.endswith(".py"):
+        return True
+    return norm.endswith(_WL002_OPS_FILES)
+
+
+def _wl002_sanctioned(node: ast.AST,
+                      parents: Dict[ast.AST, ast.AST]) -> bool:
+    for anc in _ancestors(node, parents):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call) and \
+                        _call_name(expr.func) in _WL002_SANCTIONED_SCOPES:
+                    return True
+        elif isinstance(anc, ast.ClassDef) and \
+                anc.name in _WL002_SANCTIONED_CLASSES:
+            return True
+    return False
+
+
+def _check_wl002(path: str, tree: ast.AST,
+                 parents: Dict[ast.AST, ast.AST]) -> List[Violation]:
+    if not _wl002_in_scope(path):
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name not in _WL002_SYNC_ATTRS:
+            continue
+        if _wl002_sanctioned(node, parents):
+            continue
+        out.append(Violation(
+            "WL002", path, node.lineno,
+            f"host sync `{name}` outside a sanctioned seam; wrap in "
+            f"_phases.device_scope / _phases.transfer_scope (or defer "
+            f"via DeferredStats)",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# WL003 mutation-hook completeness
+
+
+def _writes_tracked_field(fn: ast.AST, fields: Set[str]) -> Set[str]:
+    written: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                base = target
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self" and base.attr in fields:
+                    written.add(base.attr)
+    return written
+
+
+def _calls_hook(fn: ast.AST, hooks: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self" and \
+                node.func.attr in hooks:
+            return True
+    return False
+
+
+def _check_wl003(path: str, tree: ast.AST,
+                 parents: Dict[ast.AST, ast.AST]) -> List[Violation]:
+    norm = path.replace("\\", "/")
+    specs = [(cls, spec) for (suffix, cls), spec in SLOT_SPECS.items()
+             if norm.endswith(suffix)]
+    if not specs:
+        return []
+    out: List[Violation] = []
+    by_class = dict(specs)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or node.name not in by_class:
+            continue
+        fields, hooks = by_class[node.name]
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue  # nothing deposited yet at construction
+            written = _writes_tracked_field(fn, fields)
+            if written and not _calls_hook(fn, hooks):
+                out.append(Violation(
+                    "WL003", path, fn.lineno,
+                    f"{node.name}.{fn.name} writes slot-tracked "
+                    f"{sorted(written)} without calling a deposit drop "
+                    f"hook ({'/'.join(sorted(hooks))})",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# WL004 traced-purity
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # partial(jax.jit, ...) / jax.jit(...) / jit(...)
+        if _call_name(dec.func) in ("jit", "partial"):
+            if _call_name(dec.func) == "partial":
+                return bool(dec.args) and \
+                    _call_name(dec.args[0]) == "jit"
+            return True
+        return False
+    return _call_name(dec) == "jit" or _dotted(dec).endswith(".jit")
+
+
+def _traced_roots(tree: ast.AST) -> List[ast.AST]:
+    """jit-decorated defs plus functions handed to while_loop-family
+    combinators (by local name or inline lambda)."""
+    roots: List[ast.AST] = []
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                roots.append(node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _call_name(node.func) in _WL004_LOOP_FUNCS:
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    roots.append(arg)
+                elif isinstance(arg, ast.Name) and \
+                        arg.id in defs_by_name:
+                    roots.extend(defs_by_name[arg.id])
+    return roots
+
+
+def _check_wl004(path: str, tree: ast.AST,
+                 parents: Dict[ast.AST, ast.AST]) -> List[Violation]:
+    norm = path.replace("\\", "/")
+    if "/ops/" not in norm and not norm.startswith("ops/"):
+        return []
+    out: List[Violation] = []
+    seen: Set[int] = set()
+    for root in _traced_roots(tree):
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            dotted = _dotted(node.func)
+            bad = None
+            if dotted.startswith("time.") or dotted.startswith("random."):
+                bad = dotted
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                bad = "print"
+            if bad:
+                seen.add(id(node))
+                out.append(Violation(
+                    "WL004", path, node.lineno,
+                    f"impure call `{bad}` inside a traced "
+                    f"(jit/while_loop) body",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# WL005 bare-thread/bare-lock
+
+
+def _check_wl005(path: str, tree: ast.AST,
+                 parents: Dict[ast.AST, ast.AST]) -> List[Violation]:
+    if path.endswith(_WL005_EXEMPT_SUFFIXES):
+        return []
+    # names imported straight off threading ("from threading import X")
+    bare: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in ("Lock", "RLock", "Thread"):
+                    bare.add(alias.asname or alias.name)
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        kind = None
+        if dotted in ("threading.Lock", "threading.RLock",
+                      "threading.Thread"):
+            kind = dotted.split(".")[1]
+        elif isinstance(node.func, ast.Name) and node.func.id in bare:
+            kind = node.func.id
+        if kind:
+            wrapper = {"Lock": "make_lock", "RLock": "make_rlock",
+                       "Thread": "make_thread"}[kind]
+            out.append(Violation(
+                "WL005", path, node.lineno,
+                f"bare threading.{kind}; use analysis.lockcheck."
+                f"{wrapper} so the lock-order checker sees it",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# drivers
+
+_CHECKS = (_check_wl001, _check_wl002, _check_wl003, _check_wl004,
+           _check_wl005)
+
+
+def lint_source(source: str, path: str,
+                rules: Optional[Iterable[str]] = None) -> List[Violation]:
+    """Lint one source blob; ``path`` determines rule scoping."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Violation("WL000", path, exc.lineno or 1,
+                          f"syntax error: {exc.msg}")]
+    parents = _parents(tree)
+    active = set(rules) if rules is not None else set(RULES)
+    violations: List[Violation] = []
+    for check in _CHECKS:
+        rule = check.__name__[-5:].upper()
+        if rule in active:
+            violations.extend(check(path, tree, parents))
+    violations = _filter_disabled(violations, source.splitlines())
+    return sorted(violations, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_path(path: Path, root: Optional[Path] = None,
+              rules: Optional[Iterable[str]] = None) -> List[Violation]:
+    rel = str(path.relative_to(root)) if root else str(path)
+    return lint_source(path.read_text(), rel, rules)
+
+
+#: tree scan roots, relative to the repo root
+SCAN_ROOTS = ("waffle_con_tpu", "scripts", "bench.py", "conftest.py")
+#: pruned anywhere they appear
+SKIP_PARTS = {"tests", "__pycache__", ".git", "evidence"}
+
+
+def iter_python_files(root: Path) -> List[Path]:
+    files: List[Path] = []
+    for entry in SCAN_ROOTS:
+        target = root / entry
+        if target.is_file():
+            files.append(target)
+        elif target.is_dir():
+            for p in sorted(target.rglob("*.py")):
+                if not SKIP_PARTS.intersection(p.parts):
+                    files.append(p)
+    return files
+
+
+def lint_tree(root: Path,
+              rules: Optional[Iterable[str]] = None) -> List[Violation]:
+    violations: List[Violation] = []
+    for path in iter_python_files(root):
+        violations.extend(lint_path(path, root=root, rules=rules))
+    return violations

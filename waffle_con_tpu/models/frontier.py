@@ -29,11 +29,11 @@ is byte-identical to M=1 by construction.  This module only decides
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional
 
 from waffle_con_tpu.ops import ragged as _ragged
 from waffle_con_tpu.ops.ragged import GangMember
+from waffle_con_tpu.utils import envspec
 
 __all__ = ["FrontierSpeculator", "GangMember", "explicit_width"]
 
@@ -41,7 +41,7 @@ __all__ = ["FrontierSpeculator", "GangMember", "explicit_width"]
 def explicit_width() -> Optional[int]:
     """The ``WAFFLE_FRONTIER_M`` override, or None when unset/garbage.
     0/1 both mean "disabled" (M=1 is the serial search)."""
-    env = os.environ.get("WAFFLE_FRONTIER_M")
+    env = envspec.get_raw("WAFFLE_FRONTIER_M")
     if env:
         try:
             return max(1, int(env))

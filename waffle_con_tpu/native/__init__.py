@@ -25,11 +25,12 @@ import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.ops.scorer import BranchStats, WavefrontScorer
+from waffle_con_tpu.analysis import lockcheck
 
 _HERE = pathlib.Path(__file__).resolve().parent
 _SRC = _HERE / "src" / "waffle_native.cpp"
 _LIB = _HERE / "_libwaffle.so"
-_LOCK = threading.Lock()
+_LOCK = lockcheck.make_lock("native.BUILD")
 _lib: Optional[ctypes.CDLL] = None
 
 _I64 = ctypes.c_longlong

@@ -48,6 +48,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
+
 #: every reason :func:`trigger` is called with somewhere in the codebase
 TRIGGER_REASONS = (
     "deadline_exceeded",
@@ -68,7 +71,7 @@ DEFAULT_DEDUPE_S = 300.0
 
 def _ring_size() -> int:
     try:
-        return max(16, int(os.environ.get("WAFFLE_FLIGHT_RING", "") or
+        return max(16, int(envspec.get_raw("WAFFLE_FLIGHT_RING", "") or
                            DEFAULT_RING_SIZE))
     except ValueError:
         return DEFAULT_RING_SIZE
@@ -76,7 +79,7 @@ def _ring_size() -> int:
 
 def _dedupe_window_s() -> float:
     try:
-        env = os.environ.get("WAFFLE_FLIGHT_DEDUPE_S", "")
+        env = envspec.get_raw("WAFFLE_FLIGHT_DEDUPE_S", "")
         return float(env) if env != "" else DEFAULT_DEDUPE_S
     except ValueError:
         return DEFAULT_DEDUPE_S
@@ -100,7 +103,7 @@ class FlightRecorder:
         self._ring: "collections.deque[Tuple]" = collections.deque(
             maxlen=ring_size or _ring_size()
         )
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.flight.FlightRecorder")
         #: (reason, trace_id) -> last fire timestamp; entries older
         #: than the dedupe window expire, so a RECURRING incident
         #: re-fires (constructor arg pins the window for tests; None
@@ -171,7 +174,7 @@ class FlightRecorder:
             self._seq += 1
             seq = self._seq
         incident = self._build_incident(seq, reason, trace_id, detail)
-        dump_dir = os.environ.get("WAFFLE_FLIGHT_DIR", "")
+        dump_dir = envspec.get_raw("WAFFLE_FLIGHT_DIR", "")
         if dump_dir:
             try:
                 os.makedirs(dump_dir, exist_ok=True)
@@ -231,7 +234,7 @@ _RECORDER = FlightRecorder()
 #: incident.  Exceptions are swallowed: a broken listener must never
 #: take down the anomaly path.
 _LISTENERS: List = []
-_LISTENER_LOCK = threading.Lock()
+_LISTENER_LOCK = lockcheck.make_lock("obs.flight.LISTENERS")
 
 
 def add_trigger_listener(fn) -> None:

@@ -41,7 +41,6 @@ ring — ``bench.py --explain`` dumps them as a timeline.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Dict, Optional
 
@@ -49,6 +48,7 @@ from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import phases as obs_phases
 from waffle_con_tpu.obs import trace as obs_trace
+from waffle_con_tpu.utils import envspec
 
 #: dispatch method -> short op label (the same vocabulary as the scorer
 #: counter keys and the supervisor's event ``op`` field)
@@ -190,7 +190,7 @@ FRONTIER_SAMPLE_DEFAULT = 64
 
 
 def _frontier_interval() -> int:
-    env = os.environ.get("WAFFLE_FRONTIER_SAMPLE", "")
+    env = envspec.get_raw("WAFFLE_FRONTIER_SAMPLE", "")
     if env == "":
         return FRONTIER_SAMPLE_DEFAULT
     try:

@@ -23,9 +23,10 @@ created and the engines' per-search cost is a handful of boolean checks.
 from __future__ import annotations
 
 import bisect
-import os
-import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 #: default latency buckets (seconds): spans the observed dispatch range
 #: from sub-100us fused XLA:CPU calls to multi-second tunneled TPU
@@ -46,7 +47,7 @@ class Counter:
 
     def __init__(self) -> None:
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.metrics.Counter")
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
@@ -64,7 +65,7 @@ class Gauge:
 
     def __init__(self) -> None:
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.metrics.Gauge")
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -101,7 +102,7 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.metrics.Histogram")
 
     def _bucket_index(self, value: float) -> int:
         # bisect_left matches the inclusive-upper-edge contract
@@ -162,7 +163,7 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.metrics.MetricsRegistry")
         #: name -> (kind, {label_key: instrument}, histogram bounds)
         self._families: Dict[str, Tuple[str, Dict[_LabelKey, object], Optional[tuple]]] = {}
         #: exposition-time callbacks (e.g. the SLO tracker re-publishing
@@ -306,7 +307,7 @@ def metrics_enabled() -> bool:
     a programmatic :func:`enable_metrics` override)."""
     if _FORCED is not None:
         return _FORCED
-    return os.environ.get("WAFFLE_METRICS", "") not in ("", "0")
+    return envspec.flag("WAFFLE_METRICS")
 
 
 def enable_metrics(on: bool = True) -> None:

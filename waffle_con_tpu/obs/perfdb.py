@@ -41,6 +41,8 @@ import platform as _platform
 import time
 from typing import Dict, List, Optional, Tuple
 
+from waffle_con_tpu.utils import envspec
+
 #: perfdb record major: bump ONLY on a field-meaning change readers
 #: cannot tolerate; additive fields do not bump it
 SCHEMA = 1
@@ -82,7 +84,7 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
 
 
 def default_path() -> str:
-    env = os.environ.get("WAFFLE_PERFDB", "")
+    env = envspec.get_raw("WAFFLE_PERFDB", "")
     if env:
         return env
     root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -43,10 +43,12 @@ remainder.  A deferred resolve after close is accounted as late
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 PHASES = ("host_prep", "device_compute", "transfer", "host_post")
 
@@ -66,7 +68,7 @@ def profiling_enabled() -> bool:
     :func:`enable_profiling` override)."""
     if _FORCED is not None:
         return _FORCED
-    return os.environ.get("WAFFLE_PROFILE", "") not in ("", "0")
+    return envspec.flag("WAFFLE_PROFILE")
 
 
 def enable_profiling(on: bool = True) -> None:
@@ -170,7 +172,7 @@ class DispatchRecord:
 #: never nest: the engines issue one blocking scorer call at a time)
 _ACTIVE = threading.local()
 
-_agg_lock = threading.Lock()
+_agg_lock = lockcheck.make_lock("obs.phases.AGG")
 #: (kernel, op, k, geom) -> {phase: seconds, "count": n, "wall_s": s}
 _agg: Dict[Tuple[str, str, int, str], Dict[str, float]] = {}
 _recent: List[DispatchRecord] = []

@@ -33,10 +33,11 @@ Knobs: ``WAFFLE_SLO_WINDOW_S`` (window age, default 300s),
 from __future__ import annotations
 
 import collections
-import os
-import threading
 import time
 from typing import Deque, Dict, Optional, Tuple
+
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 DEFAULT_WINDOW_S = 300.0
 DEFAULT_K = 3.0
@@ -50,7 +51,7 @@ QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
 def window_age_s() -> float:
     try:
-        return float(os.environ.get("WAFFLE_SLO_WINDOW_S", "") or
+        return float(envspec.get_raw("WAFFLE_SLO_WINDOW_S", "") or
                      DEFAULT_WINDOW_S)
     except ValueError:
         return DEFAULT_WINDOW_S
@@ -58,7 +59,7 @@ def window_age_s() -> float:
 
 def slow_search_k() -> float:
     try:
-        return float(os.environ.get("WAFFLE_SLO_K", "") or DEFAULT_K)
+        return float(envspec.get_raw("WAFFLE_SLO_K", "") or DEFAULT_K)
     except ValueError:
         return DEFAULT_K
 
@@ -119,7 +120,7 @@ class SloTracker:
 
     def __init__(self, window_s: Optional[float] = None) -> None:
         age = window_age_s() if window_s is None else window_s
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.slo.SloTracker")
         self._windows: Dict[str, RollingWindow] = {
             "dispatch": RollingWindow(age, max_count=4096),
             "job": RollingWindow(age, max_count=1024),
@@ -216,7 +217,7 @@ class SloTracker:
 
 _TRACKER = SloTracker()
 _COLLECTOR_REGISTERED = False
-_COLLECTOR_LOCK = threading.Lock()
+_COLLECTOR_LOCK = lockcheck.make_lock("obs.slo.COLLECTOR")
 
 
 def tracker() -> SloTracker:

@@ -54,6 +54,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
+
 
 class _NullSpan:
     """Shared no-op span: the entire disabled-mode cost."""
@@ -191,7 +194,7 @@ class Tracer:
 
     def __init__(self) -> None:
         self._forced: Optional[bool] = None
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.trace.Tracer")
         self._events: List[Dict] = []
         self._totals: Dict[str, float] = {}
         self._t0_ns = time.perf_counter_ns()
@@ -205,7 +208,7 @@ class Tracer:
     def enabled(self) -> bool:
         if self._forced is not None:
             return self._forced
-        return os.environ.get("WAFFLE_TRACE", "") not in ("", "0")
+        return envspec.flag("WAFFLE_TRACE")
 
     def enable(self, on: bool = True) -> None:
         self._forced = bool(on)
@@ -335,10 +338,10 @@ def tracing_enabled() -> bool:
 def _env_autosetup() -> None:
     """Honor ``WAFFLE_TRACE=<path>`` (write at exit) and
     ``WAFFLE_TRACE_JAX=1`` once at import."""
-    value = os.environ.get("WAFFLE_TRACE", "")
+    value = envspec.get_raw("WAFFLE_TRACE", "")
     if value not in ("", "0", "1"):
         atexit.register(lambda: _TRACER.write_chrome_trace(value))
-    if os.environ.get("WAFFLE_TRACE_JAX", "") not in ("", "0"):
+    if envspec.flag("WAFFLE_TRACE_JAX"):
         _TRACER.enable_jax_bridge(True)
 
 

@@ -36,7 +36,6 @@ ops/jax_scorer.py).  Parity is enforced by tests/test_pallas_run.py
 from __future__ import annotations
 
 import functools
-import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -47,6 +46,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from waffle_con_tpu.ops.jax_scorer import INF, REC_CAP, VOTE_EPS
+from waffle_con_tpu.utils import envspec
 
 #: sublane alignment of the int16 reads staging array ((16, 128) tiling)
 _ALIGN = 16
@@ -61,7 +61,7 @@ _VMEM_BUDGET = 12 * 1024 * 1024
 def pallas_mode() -> str:
     """``"tpu"`` | ``"interpret"`` | ``"off"`` — resolved once per
     process from WAFFLE_PALLAS (default: on iff a TPU is attached)."""
-    env = os.environ.get("WAFFLE_PALLAS", "auto")
+    env = envspec.get_raw("WAFFLE_PALLAS", "auto")
     if env == "0":
         mode = "off"
     elif env == "interpret":

@@ -62,7 +62,6 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import threading
 from dataclasses import dataclass, field
 from functools import partial
@@ -72,6 +71,8 @@ import numpy as np
 
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import phases as _phases
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 logger = logging.getLogger(__name__)
 
@@ -92,7 +93,7 @@ class ArenaExhausted(RuntimeError):
 
 def enabled() -> bool:
     """Ragged dispatch master switch (``WAFFLE_RAGGED``, default on)."""
-    return os.environ.get("WAFFLE_RAGGED", "1").strip().lower() not in (
+    return envspec.get_raw("WAFFLE_RAGGED", "1").strip().lower() not in (
         "0", "false", "off", "no",
     )
 
@@ -161,10 +162,7 @@ def geometry_hint() -> Optional[GeometryHint]:
 
 
 def _env_int(name: str, default: int, lo: int, hi: int) -> int:
-    try:
-        return max(lo, min(hi, int(os.environ.get(name, default))))
-    except ValueError:
-        return default
+    return envspec.get_int(name, default, lo, hi)
 
 
 @dataclass(frozen=True)
@@ -352,7 +350,7 @@ class BandArena:
         self.gang = cfg.gang
         self.A = cfg.alphabet
         self.pages = PageTable(cfg.rows // cfg.page_rows, cfg.page_rows)
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("ops.ragged.BandArena")
         self._resident: Dict[int, _Residency] = {}
         self._injected: Dict[Tuple[int, int], _Injected] = {}
         self._counters = {
@@ -1076,10 +1074,13 @@ class FrontierGang:
 
             sc = self.scorer
             if self._reads_host is None:
-                self._reads_host = (
-                    np.asarray(jax.device_get(sc._reads)),
-                    np.asarray(jax.device_get(sc._rlen)),
-                )
+                # one-time staging fetch; attributed to the active
+                # dispatch record when there is one (NULL_SCOPE when not)
+                with _phases.transfer_scope(_phases.current()):
+                    self._reads_host = (
+                        np.asarray(jax.device_get(sc._reads)),
+                        np.asarray(jax.device_get(sc._rlen)),
+                    )
             reads_np, rlen_np = self._reads_host
             reps = P // reads_np.shape[0]
             t = (
@@ -1306,7 +1307,7 @@ def serving_active() -> bool:
 # up one arena per replica; without this cache each would recompile the
 # identical kernel ladder.
 
-_KERNEL_LOCK = threading.Lock()
+_KERNEL_LOCK = lockcheck.make_lock("ops.ragged.KERNEL_CACHE")
 _RAGGED_KERNEL = None
 
 
@@ -1330,7 +1331,7 @@ def _shared_kernel(arena: "BandArena"):
 # counters and WOULD collide across replicas.
 
 _ARENA: Optional[BandArena] = None
-_ARENA_LOCK = threading.Lock()
+_ARENA_LOCK = lockcheck.make_lock("ops.ragged.PROCESS_ARENA")
 _NAMED_ARENAS: Dict[str, BandArena] = {}
 
 

@@ -26,7 +26,6 @@ cf. ``/root/reference/src/consensus.rs:546-552``).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -36,6 +35,8 @@ import numpy as np
 from waffle_con_tpu.config import CdwfaConfig
 from waffle_con_tpu.ops.alignment import wfa_ed_config
 from waffle_con_tpu.ops.dwfa import DWFALite
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 
 class BranchStats:
@@ -159,7 +160,7 @@ def _phases_mod():
 
 #: process-wide overlap accounting: seconds of host work that ran while
 #: a deferred result was still un-fetched (see ``DeferredStats``)
-_overlap_lock = threading.Lock()
+_overlap_lock = lockcheck.make_lock("ops.scorer.OVERLAP")
 _overlap_total = 0.0
 
 
@@ -203,7 +204,7 @@ def deferred_sync_enabled() -> bool:
     """Whether scorers may return :class:`DeferredStats`
     (``WAFFLE_ASYNC_SYNC``, default on; ``0`` forces the old eager
     fetch everywhere)."""
-    return os.environ.get("WAFFLE_ASYNC_SYNC", "1") != "0"
+    return envspec.get_raw("WAFFLE_ASYNC_SYNC", "1") != "0"
 
 
 #: counter names that each correspond to one blocking device dispatch;

@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from waffle_con_tpu.ops.jax_scorer import _col_step, _stats_core
+from waffle_con_tpu.analysis import lockcheck
 
 # jax.shard_map only exists from jax 0.5; older versions (this container
 # ships 0.4.x) keep it under the experimental namespace
@@ -56,7 +57,7 @@ else:
 #: platform — and a missing device plugin makes that initialisation
 #: retry (and log) on every call.  shard_for_config used to pay that
 #: probe per admitted job; now the answer is taken once per process.
-_PROBE_LOCK = threading.Lock()
+_PROBE_LOCK = lockcheck.make_lock("parallel.mesh.PROBE")
 _PROBE_CACHE: Dict[str, int] = {}
 
 

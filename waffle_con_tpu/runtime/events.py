@@ -23,7 +23,9 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-_LOCK = threading.Lock()
+from waffle_con_tpu.analysis import lockcheck
+
+_LOCK = lockcheck.make_lock("runtime.events.LOG")
 _EVENTS: List[Dict] = []
 #: hard cap; beyond it new events replace a marker rather than growing
 _MAX_EVENTS = 10_000

@@ -47,6 +47,8 @@ from typing import List, Optional
 import numpy as np
 
 from waffle_con_tpu.runtime import events
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 FAULT_KINDS = (
     "timeout", "device_loss", "garbage", "pallas_compile", "cache_corrupt",
@@ -110,7 +112,7 @@ class FaultPlan:
 
     def __init__(self, specs: Optional[List[FaultSpec]] = None) -> None:
         self.specs: List[FaultSpec] = list(specs or [])
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("runtime.faults.FaultPlan")
 
     def add(
         self,
@@ -167,7 +169,7 @@ def active() -> Optional[FaultPlan]:
     global _ACTIVE, _ENV_CHECKED
     if not _ENV_CHECKED:
         _ENV_CHECKED = True
-        spec = os.environ.get("WAFFLE_FAULTS", "")
+        spec = envspec.get_raw("WAFFLE_FAULTS", "")
         if spec:
             _ACTIVE = plan_from_env(spec)
     return _ACTIVE

@@ -16,12 +16,12 @@ raises in strict mode (``config.watchdog_strict`` or
 from __future__ import annotations
 
 import logging
-import os
 import time
 from typing import Dict, Optional
 
 from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS
 from waffle_con_tpu.runtime import events
+from waffle_con_tpu.utils import envspec
 
 logger = logging.getLogger(__name__)
 
@@ -107,7 +107,7 @@ def enforce_dispatch_budget(
             "disengaged (see counter breakdown in last_search_stats)"
         )
         strict = bool(getattr(config, "watchdog_strict", False)) or (
-            os.environ.get("WAFFLE_WATCHDOG") == "strict"
+            envspec.get_raw("WAFFLE_WATCHDOG") == "strict"
         )
         if strict:
             raise WatchdogError(message)

@@ -56,6 +56,7 @@ from waffle_con_tpu.obs.instrument import TIMED_OPS
 from waffle_con_tpu.ops import ragged as ops_ragged
 from waffle_con_tpu.ops.scorer import resolve_stats
 from waffle_con_tpu.serve.job import ServiceClosed
+from waffle_con_tpu.analysis import lockcheck
 
 logger = logging.getLogger(__name__)
 
@@ -201,7 +202,7 @@ class BatchingDispatcher:
         with self._cond:
             if self._thread is not None or self._closed:
                 return
-            self._thread = threading.Thread(
+            self._thread = lockcheck.make_thread(
                 target=self._loop,
                 name=f"waffle-serve-{self._name}-dispatcher",
                 daemon=True,

@@ -32,6 +32,7 @@ from typing import Any, Optional, Sequence, Tuple
 from waffle_con_tpu.config import CdwfaConfig
 from waffle_con_tpu.obs.trace import JOB_PID_BASE, TraceContext
 from waffle_con_tpu.runtime.watchdog import enforce_deadline
+from waffle_con_tpu.analysis import lockcheck
 
 JOB_KINDS = ("single", "dual", "priority")
 
@@ -129,7 +130,7 @@ class JobHandle:
             chrome_pid=JOB_PID_BASE + job_id,
             label=label,
         )
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serve.job.JobHandle")
         self._done = threading.Event()
         self._running = threading.Event()
         self._status = JobStatus.QUEUED

@@ -53,6 +53,8 @@ from waffle_con_tpu.serve.job import (
     ServiceOverloaded,
 )
 from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 
 #: replica states
 UP = "up"
@@ -127,7 +129,7 @@ class ReplicatedService:
         self.config = config if config is not None else ReplicatedConfig()
         base = (self.config.base if self.config.base is not None
                 else ServeConfig())
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serve.replicas.ReplicatedService")
         self._closed = False
         self._stats_published_at = 0.0
         slices = self._device_slices(self.config.replicas)
@@ -375,7 +377,7 @@ class ReplicatedService:
         """Front-door-owned ``WAFFLE_STATS_FILE`` publication (same
         throttle + atomic-rename contract as the single service; the
         payload gains a top-level ``replicas`` table)."""
-        path = os.environ.get("WAFFLE_STATS_FILE", "")
+        path = envspec.get_raw("WAFFLE_STATS_FILE", "")
         if not path:
             return
         now = time.monotonic()

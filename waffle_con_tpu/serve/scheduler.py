@@ -30,6 +30,7 @@ import time
 from typing import Callable, List, Optional
 
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.analysis import lockcheck
 from waffle_con_tpu.serve.job import (
     JobHandle,
     ServiceClosed,
@@ -166,7 +167,7 @@ class WorkerPool:
         self._name = name
         self._stop = threading.Event()
         self._threads = [
-            threading.Thread(
+            lockcheck.make_thread(
                 target=self._loop,
                 name=f"waffle-serve-{name}-w{i}",
                 daemon=True,

@@ -38,6 +38,8 @@ from waffle_con_tpu.obs import slo as obs_slo
 from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.ops import ragged as ops_ragged
 from waffle_con_tpu.runtime import events
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.utils import envspec
 from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
 from waffle_con_tpu.serve.dispatcher import BatchingDispatcher, CoalescingScorer
 from waffle_con_tpu.serve.job import (
@@ -164,7 +166,7 @@ class ConsensusService:
             self.config.workers, self._queue, self._run_job,
             name=self.config.name,
         )
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serve.service.ConsensusService")
         self._next_id = 0
         self._closed = False
         self._handles: List[JobHandle] = []
@@ -422,7 +424,7 @@ class ConsensusService:
         """When ``WAFFLE_STATS_FILE`` is set, atomically rewrite it with
         the live stats + SLO snapshot (throttled) so ``waffle_top`` can
         poll a serving process without a network endpoint."""
-        path = os.environ.get("WAFFLE_STATS_FILE", "")
+        path = envspec.get_raw("WAFFLE_STATS_FILE", "")
         if not path or not self._publish:
             return
         now = time.monotonic()

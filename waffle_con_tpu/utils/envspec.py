@@ -1,0 +1,219 @@
+"""Central registry of every ``WAFFLE_*`` environment knob.
+
+Every env read in the package goes through this module (machine-enforced
+by lint rule **WL001** in :mod:`waffle_con_tpu.analysis.lint`): a knob
+must be declared here — name, type, default, one-line doc — before any
+code may read it, and the declared set is doc-synced against the README
+reference table (``scripts/waffle_lint.py --env-table`` emits the
+table).  That kills the two historical failure modes: knobs read but
+never documented, and knobs documented but no longer read.
+
+The getters deliberately mirror ``os.environ.get`` semantics so call
+sites migrate without behavior change:
+
+* :func:`get_raw` — exact ``os.environ.get(name, default)`` passthrough
+  (callers keep their local parsing quirks: tri-states, false-sets,
+  save/restore round-trips).
+* :func:`flag` — the package's ``not in ("", "0")`` enablement idiom
+  (metrics/trace/profile/lockcheck family).
+* :func:`get_int` / :func:`get_float` — numeric with optional clamping;
+  unset or garbage falls back to the default (never raises).
+* :func:`is_set` — presence test.
+
+Reading an *unregistered* name raises ``KeyError`` at call time, so a
+new knob cannot ship without its registry row (and therefore without
+README documentation).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "EnvKnob", "KNOBS", "knobs", "get_raw", "flag", "get_int",
+    "get_float", "is_set", "env_table_markdown",
+]
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    """One registered environment knob."""
+
+    name: str      # full env-var name, WAFFLE_*
+    kind: str      # "flag" | "int" | "float" | "str" | "path" | "enum"
+    default: str   # human-readable default (display only, not parsed)
+    doc: str       # one-line description for the README table
+
+
+def _k(name: str, kind: str, default: str, doc: str) -> Tuple[str, EnvKnob]:
+    return name, EnvKnob(name, kind, default, doc)
+
+
+#: the authoritative knob registry, grouped roughly by subsystem.  The
+#: README env-reference table is generated from this dict — edit here,
+#: then re-run ``python scripts/waffle_lint.py --env-table``.
+KNOBS: Dict[str, EnvKnob] = dict((
+    # -- ragged cross-job dispatch (ops/ragged.py) ---------------------
+    _k("WAFFLE_RAGGED", "flag", "1 (on)",
+       "Ragged dispatch master switch; `0`/`false`/`off`/`no` disable"),
+    _k("WAFFLE_RAGGED_ROWS", "int", "256",
+       "Band-arena pool rows (reads across all jobs), clamped 16..65536"),
+    _k("WAFFLE_RAGGED_PAGE", "int", "8",
+       "Arena rows per page (residency quantum), clamped 1..256"),
+    _k("WAFFLE_RAGGED_E", "int", "32",
+       "Arena pool band half-width E (W = 2E + 2), clamped 8..512"),
+    _k("WAFFLE_RAGGED_L", "int", "512",
+       "Arena staged read columns, clamped 64..32768"),
+    _k("WAFFLE_RAGGED_C", "int", "2048",
+       "Arena per-member consensus capacity, clamped 256..65536"),
+    _k("WAFFLE_RAGGED_GANG", "int", "8",
+       "Max members per ragged kernel call, clamped 2..64"),
+    # -- kernel selection (ops/) ---------------------------------------
+    _k("WAFFLE_PALLAS", "enum", "auto",
+       "Pallas kernel mode: `auto` (on iff TPU), `1` (interpret on "
+       "CPU), `interpret`, `0` (off)"),
+    _k("WAFFLE_PALLAS_I16", "flag", "1 (on)",
+       "int16 DP tiles in the Pallas run kernels; `0` forces int32"),
+    _k("WAFFLE_XLA_I16", "enum", "unset (auto)",
+       "int16 band-state for XLA run kernels: `1` force on, `0` force "
+       "off, unset = TPU only"),
+    _k("WAFFLE_RUN_COLS", "int", "unset (per-backend, 4)",
+       "Speculative columns K per device loop iteration, clamped "
+       "1..64; read per dispatch"),
+    # -- search / frontier speculation ---------------------------------
+    _k("WAFFLE_FRONTIER_M", "int", "unset (adaptive)",
+       "Explicit frontier-gang width M; `0`/`1` disable speculation"),
+    _k("WAFFLE_FRONTIER_SAMPLE", "int", "64",
+       "Frontier sampler pop decimation (one record per N pops); `0` "
+       "disables"),
+    # -- runtime supervision -------------------------------------------
+    _k("WAFFLE_WATCHDOG", "enum", "unset (warn)",
+       "`strict` turns dispatch-budget overruns into WatchdogError"),
+    _k("WAFFLE_FAULTS", "str", "unset",
+       "Fault-injection plan: `kind[:backend[:op[:at[:count]]]],...`"),
+    _k("WAFFLE_ASYNC_SYNC", "flag", "1 (on)",
+       "Deferred device-stats sync; `0` restores eager per-dispatch "
+       "fetch"),
+    _k("WAFFLE_LOCKCHECK", "flag", "0 (off)",
+       "Runtime lock-order checker: instrumented locks record "
+       "acquisition edges and raise on a cyclic (inversion) order"),
+    # -- observability -------------------------------------------------
+    _k("WAFFLE_METRICS", "flag", "0 (off)",
+       "Metrics registry recording (counters/gauges/histograms)"),
+    _k("WAFFLE_TRACE", "str", "unset (off)",
+       "Host tracing: `1` in memory, a path auto-writes Chrome trace "
+       "at exit"),
+    _k("WAFFLE_TRACE_JAX", "flag", "0 (off)",
+       "Bridge host spans into jax.profiler trace annotations"),
+    _k("WAFFLE_PROFILE", "flag", "0 (off)",
+       "Per-dispatch phase breakdown profiling"),
+    _k("WAFFLE_FLIGHT_RING", "int", "2048",
+       "Flight-recorder ring capacity in records (min 16)"),
+    _k("WAFFLE_FLIGHT_DEDUPE_S", "float", "300",
+       "Incident (reason, trace) dedupe window in seconds; `0` "
+       "disables dedupe"),
+    _k("WAFFLE_FLIGHT_DIR", "path", "unset (in-memory only)",
+       "Directory receiving `incident-<seq>-<reason>.json` dumps"),
+    _k("WAFFLE_SLO_WINDOW_S", "float", "300",
+       "SLO rolling-window age bound in seconds"),
+    _k("WAFFLE_SLO_K", "float", "3.0",
+       "Slow-search threshold: k x rolling p95"),
+    _k("WAFFLE_STATS_FILE", "path", "unset (off)",
+       "Serving stats snapshot file, atomically rewritten each refresh"),
+    _k("WAFFLE_PERFDB", "path", "evidence/perfdb.jsonl",
+       "Performance-history database path override"),
+    # -- CI / scripts (read by scripts/ci.sh and helpers) --------------
+    _k("WAFFLE_PERFDB_TOLERANCE", "float", "0.05",
+       "CI: allowed fractional drop vs the rolling perfdb baseline"),
+    _k("WAFFLE_PERFDB_SERVE_TOLERANCE", "float", "0.15",
+       "CI: wider perfdb tolerance band for serving kinds"),
+    _k("WAFFLE_PERFDB_WINDOW", "int", "10",
+       "CI: perfdb rolling-baseline window (records)"),
+    _k("WAFFLE_MICROBENCH_FLOOR", "float", "900",
+       "CI: absolute microbench steps/s backstop floor"),
+    _k("WAFFLE_TIE_HEAVY_CEILING_S", "float", "120",
+       "CI: tie-heavy queue benchmark wall-clock ceiling in seconds"),
+    _k("WAFFLE_STORM_JOBS_FLOOR", "float", "3.0",
+       "CI: storm-harness multi-replica jobs/s floor"),
+    _k("WAFFLE_STORM_P95_CEIL", "float", "3.0",
+       "CI: storm-harness p95 job-latency ceiling in seconds"),
+    _k("WAFFLE_STORM_SPEEDUP", "float", "0.8",
+       "CI: storm multi/single jobs/s sanity floor"),
+    _k("WAFFLE_STORM_SHED_P95", "float", "12.0",
+       "CI: p95 ceiling with one demoted (shedding) replica, seconds"),
+    _k("WAFFLE_SUITE_TIMEOUT", "int", "600",
+       "Sharded suite runner per-shard timeout in seconds"),
+))
+
+
+def knobs() -> Tuple[EnvKnob, ...]:
+    """All registered knobs, in registry (subsystem-grouped) order."""
+    return tuple(KNOBS.values())
+
+
+def _require(name: str) -> None:
+    if name not in KNOBS:
+        raise KeyError(
+            f"unregistered WAFFLE env knob {name!r}: declare it in "
+            "waffle_con_tpu/utils/envspec.py (and the README table) "
+            "before reading it"
+        )
+
+
+def get_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Exact ``os.environ.get(name, default)`` for a registered knob."""
+    _require(name)
+    return os.environ.get(name, default)
+
+
+def flag(name: str) -> bool:
+    """The package's enablement idiom: set and not ``"0"``."""
+    _require(name)
+    return os.environ.get(name, "") not in ("", "0")
+
+
+def get_int(name: str, default: int,
+            lo: Optional[int] = None, hi: Optional[int] = None) -> int:
+    """Integer knob with optional clamping; unset/garbage -> default."""
+    _require(name)
+    raw = os.environ.get(name, "")
+    try:
+        value = int(raw) if raw != "" else default
+    except ValueError:
+        return default
+    if lo is not None:
+        value = max(lo, value)
+    if hi is not None:
+        value = min(hi, value)
+    return value
+
+
+def get_float(name: str, default: float) -> float:
+    """Float knob; unset/garbage -> default."""
+    _require(name)
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw != "" else default
+    except ValueError:
+        return default
+
+
+def is_set(name: str) -> bool:
+    _require(name)
+    return name in os.environ
+
+
+def env_table_markdown() -> str:
+    """The README env-reference table (between the envspec markers)."""
+    lines = [
+        "| Knob | Type | Default | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for knob in knobs():
+        lines.append(
+            f"| `{knob.name}` | {knob.kind} | {knob.default} | "
+            f"{knob.doc} |"
+        )
+    return "\n".join(lines)
