@@ -336,6 +336,36 @@ def _emit(out, perfdb_kind=None):
         print(f"perfdb append failed: {exc!r}", file=sys.stderr)
 
 
+def _append_mixed_w_record(out):
+    """Second perfdb line for ``--serve-mix``: the mixed-W traffic
+    class lands as its own ``serve-mix-mixed-w`` record (occupancy,
+    compile count, parity bit) so ``perf_report.py --check`` can gate
+    it independently of the base heterogeneous mix."""
+    from waffle_con_tpu.obs import perfdb
+
+    mixed = out.get("mixed_w")
+    if not isinstance(mixed, dict):
+        return
+    try:
+        rec = perfdb.make_record(
+            "serve-mix-mixed-w",
+            "serve_mix_mixed_w_jobs_per_s",
+            float(mixed.get("jobs_per_s_ragged") or 0.0),
+            "jobs/s",
+            platform=out.get("device_platform", "unknown"),
+            parity=mixed.get("parity"),
+            ragged_occupancy=mixed.get("ragged_occupancy"),
+            compiles_ragged=mixed.get("compiles_ragged"),
+            mixed_w_groups=mixed.get("mixed_w_groups"),
+            recenters=mixed.get("recenters"),
+        )
+        path = perfdb.append_record(rec)
+        print(f"perfdb: appended serve-mix-mixed-w record to {path}",
+              file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 - history is best-effort
+        print(f"perfdb append failed: {exc!r}", file=sys.stderr)
+
+
 def _gang_fields(counters) -> dict:
     """Frontier-gang occupancy/commit summary for an evidence breakdown."""
     groups = counters.get("gang_groups", 0)
@@ -967,7 +997,16 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
     the baseline's mean run-cluster occupancy, per-phase recompile
     deltas (``compile_count()``), and a parity bit over EVERY job
     against serial references.  Each phase runs twice (warmup + timed)
-    so neither pays its compiles inside the timed window."""
+    so neither pays its compiles inside the timed window.
+
+    A third **mixed-W traffic class** then repeats the ragged-on/off
+    comparison on jobs whose band seeds land on three distinct pow2 E
+    geometries (E in {8, 16, 32} -> natural W in {18, 34, 66}): with
+    width-agnostic pages (``WAFFLE_RAGGED_MIXED_W``, default on) they
+    gang through one stride-masked kernel; the pre-stride arena would
+    have fragmented every one of them into solo dispatches.  The
+    result rides in the ``mixed_w`` evidence dict and lands as its own
+    ``serve-mix-mixed-w`` perfdb record."""
     import numpy as np
 
     from waffle_con_tpu import CdwfaConfigBuilder
@@ -996,12 +1035,36 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
         )
         jobs.append((reads, cfg))
 
+    # mixed-W class: same heavy-tail read counts, band seeds cycling
+    # through three distinct pow2 E geometries (seed -> _next_pow2 E)
+    mixed_shapes = []
+    mixed_jobs = []
+    band_seeds = (8, 12, 24)  # -> E 8 / 16 / 32, natural W 18 / 34 / 66
+    for i in range(num_jobs):
+        n_reads = int(min(16, 4 + rng.pareto(1.5) * 3))
+        seq_len = int(min(360, 120 + rng.pareto(1.5) * 60))
+        mixed_shapes.append((n_reads, seq_len, band_seeds[i % 3]))
+        reads = generate_test(4, seq_len, n_reads, error_rate,
+                              seed=5000 + i)[1]
+        cfg = (
+            CdwfaConfigBuilder()
+            .min_count(max(2, n_reads // 4))
+            .backend("jax")
+            .initial_band(band_seeds[i % 3])
+            .build()
+        )
+        mixed_jobs.append((reads, cfg))
+
     serial = [
         _make_engine("single", cfg, reads).consensus()
         for reads, cfg in jobs
     ]
+    mixed_serial = [
+        _make_engine("single", cfg, reads).consensus()
+        for reads, cfg in mixed_jobs
+    ]
 
-    def run_phase(ragged_on):
+    def run_phase(ragged_on, phase_jobs):
         prev = envspec.get_raw("WAFFLE_RAGGED")
         os.environ["WAFFLE_RAGGED"] = "1" if ragged_on else "0"
         ops_ragged.reset_arena()
@@ -1020,7 +1083,7 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
                 t0 = time.perf_counter()
                 handles = svc.submit_all([
                     JobRequest(kind="single", reads=tuple(r), config=c)
-                    for r, c in jobs
+                    for r, c in phase_jobs
                 ])
                 results = [h.result() for h in handles]
                 wall = time.perf_counter() - t0
@@ -1033,16 +1096,43 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
             else:
                 os.environ["WAFFLE_RAGGED"] = prev
 
-    b_res, b_wall, b_stats, b_comp = run_phase(False)
-    r_res, r_wall, r_stats, r_comp = run_phase(True)
+    b_res, b_wall, b_stats, b_comp = run_phase(False, jobs)
+    r_res, r_wall, r_stats, r_comp = run_phase(True, jobs)
+    mb_res, mb_wall, _mb_stats, _mb_comp = run_phase(False, mixed_jobs)
+    mr_res, mr_wall, mr_stats, mr_comp = run_phase(True, mixed_jobs)
 
-    parity = all(r == s for r, s in zip(b_res, serial)) and all(
+    base_parity = all(r == s for r, s in zip(b_res, serial)) and all(
         r == s for r, s in zip(r_res, serial)
     )
+    mixed_parity = all(
+        r == s for r, s in zip(mb_res, mixed_serial)
+    ) and all(r == s for r, s in zip(mr_res, mixed_serial))
+    parity = base_parity and mixed_parity  # the headline bit covers all
     ragged_occ = r_stats.get("ragged", {}).get("mean_occupancy", 0.0)
     bucketed_occ = b_stats["dispatch"].get(
         "run_cluster_mean_occupancy", 0.0
     )
+    mixed_ragged = mr_stats.get("ragged", {})
+    mixed_w = {
+        "jobs": num_jobs,
+        "shapes": mixed_shapes,
+        "band_seeds": list(band_seeds),
+        "parity": mixed_parity,
+        "jobs_per_s_ragged": round(num_jobs / mr_wall, 4),
+        "jobs_per_s_bucketed": round(num_jobs / mb_wall, 4),
+        "speedup": round(mb_wall / mr_wall, 4),
+        "ragged_occupancy": round(
+            mixed_ragged.get("mean_occupancy", 0.0), 4
+        ),
+        "mean_gang_rows": round(
+            mixed_ragged.get("mean_gang_rows", 0.0), 4
+        ),
+        "mixed_w_groups": mixed_ragged.get("mixed_w_groups", 0),
+        "groups": mixed_ragged.get("groups", 0),
+        "recenters": mixed_ragged.get("recenters", 0),
+        "compiles_ragged": mr_comp,
+        "ragged_stats": mixed_ragged,
+    }
     return {
         "metric": f"serve_mix_{num_jobs}jobs_jobs_per_s",
         "value": round(num_jobs / r_wall, 4),
@@ -1061,6 +1151,7 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
         "compile_total": compile_count(),
         "parity": parity,
         "ragged_stats": r_stats.get("ragged", {}),
+        "mixed_w": mixed_w,
         "dispatch_ragged": {
             k: v for k, v in r_stats["dispatch"].items()
             if k.startswith("ragged") or k.startswith("run_cluster")
@@ -1884,6 +1975,7 @@ def main() -> None:
         out = bench_serve_mix(args.serve_mix)
         out["device_platform"] = _current_platform()
         _emit(out, perfdb_kind="serve-mix")
+        _append_mixed_w_record(out)
         return
 
     if args.storm:

@@ -244,11 +244,25 @@ ragged = evidence["ragged_stats"]
 assert ragged["groups"] >= 1, ragged
 assert ragged["pages_used"] == 0, ragged  # completion released all pages
 assert ragged["member_store_failures"] == 0, ragged
+# mixed-W traffic class: members at three distinct band widths must
+# still gang (width-agnostic pages), byte-identical, and the per-row
+# stride is traced data -- no new compile geometries beyond the same
+# fixed envelope
+mixed = evidence["mixed_w"]
+assert mixed["parity"] is True, "mixed-W ragged diverged from serial"
+m_occ = mixed["ragged_occupancy"]
+assert m_occ > 1.5, f"mixed-W ragged occupancy {m_occ} <= 1.5"
+assert mixed["mixed_w_groups"] >= 1, mixed
+assert mixed["compiles_ragged"] <= 24, mixed["compiles_ragged"]
+assert mixed["ragged_stats"]["pages_used"] == 0, mixed["ragged_stats"]
 print(
     f"ci serve-mix smoke ok: occupancy={occ} "
     f"(bucketed {evidence['bucketed_run_occupancy']}), "
     f"compiles={evidence['compiles_ragged']}, "
-    f"{evidence['jobs_per_s_ragged']} jobs/s ragged"
+    f"{evidence['jobs_per_s_ragged']} jobs/s ragged; "
+    f"mixed-W occupancy={m_occ}, "
+    f"mixed gangs={mixed['mixed_w_groups']}/{mixed['groups']}, "
+    f"compiles={mixed['compiles_ragged']}"
 )
 PY
 
@@ -384,7 +398,7 @@ python scripts/perf_report.py --check \
   --window "${WAFFLE_PERFDB_WINDOW:-10}" \
   --floor "$MICRO_FLOOR"
 python scripts/perf_report.py --check \
-  --kinds serve-mix,storm,tie_heavy \
+  --kinds serve-mix,serve-mix-mixed-w,storm,tie_heavy \
   --tolerance "${WAFFLE_PERFDB_SERVE_TOLERANCE:-0.15}" \
   --window "${WAFFLE_PERFDB_WINDOW:-10}" \
   --floor "$MICRO_FLOOR"

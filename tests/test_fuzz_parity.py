@@ -550,3 +550,76 @@ def test_priority_chain_fuzz(seed):
             e.add_sequence_chain(c)
         engines.append(e)
     assert engines[0].consensus() == engines[1].consensus()
+
+
+@pytest.mark.serve
+@pytest.mark.parametrize("seed", range(4))
+def test_mixed_width_gang_fuzz(seed, monkeypatch):
+    """Randomized mixed-width gangs through the stride-masked ragged
+    kernel: members with randomized band seeds (distinct pow2 E
+    geometries), read counts, and lengths must stay step/code/append/
+    stats-identical to the solo ``run_extend`` path every round."""
+    from waffle_con_tpu.config import CdwfaConfig
+    from waffle_con_tpu.ops import ragged
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+
+    monkeypatch.setenv("WAFFLE_RAGGED", "1")
+    ragged.reset_arena()
+    big = 10**9
+    rng = np.random.default_rng(17000 + seed)
+    n_jobs = int(rng.integers(2, 5))
+    jobs, bands = [], []
+    for j in range(n_jobs):
+        n = int(rng.integers(3, 8))
+        length = int(rng.integers(50, 160))
+        _, reads = generate_test(
+            4, length, n, 0.03, seed=17500 + 32 * seed + j
+        )
+        jobs.append(list(reads))
+        bands.append(int(rng.choice([4, 8, 12, 20, 24])))
+    try:
+        solos = [
+            JaxScorer(r, CdwfaConfig(initial_band=b))
+            for r, b in zip(jobs, bands)
+        ]
+        rags = [
+            JaxScorer(r, CdwfaConfig(initial_band=b))
+            for r, b in zip(jobs, bands)
+        ]
+        hs_s = [s.root(np.ones(len(j), bool)) for s, j in zip(solos, jobs)]
+        hs_r = [s.root(np.ones(len(j), bool)) for s, j in zip(rags, jobs)]
+        cons_s = [b""] * n_jobs
+        cons_r = [b""] * n_jobs
+        for rnd in range(3):
+            ms = int(rng.integers(4, 12))
+            solo_out = [
+                s.run_extend(h, c, big, big, 0, 2, False, ms,
+                             allow_records=False)
+                for s, h, c in zip(solos, hs_s, cons_s)
+            ]
+            args_list = [
+                (h, c, big, big, 0, 2, False, ms)
+                for h, c in zip(hs_r, cons_r)
+            ]
+            specs = []
+            for s, a in zip(rags, args_list):
+                spec = ragged.probe((s.ragged_run_probe, a, {}))
+                assert spec is not None
+                specs.append(spec)
+            ragged.run_group(specs)
+            rag_out = [s.run_extend(*a) for s, a in zip(rags, args_list)]
+            for g, (so, ro) in enumerate(zip(solo_out, rag_out)):
+                ctx = f"seed {seed} round {rnd} job {g}"
+                assert so[:3] == ro[:3], ctx
+                np.testing.assert_array_equal(so[3].eds, ro[3].eds, ctx)
+                np.testing.assert_array_equal(so[3].occ, ro[3].occ, ctx)
+                np.testing.assert_array_equal(
+                    so[3].split, ro[3].split, ctx
+                )
+                np.testing.assert_array_equal(
+                    so[3].reached, ro[3].reached, ctx
+                )
+                cons_s[g] += so[2]
+                cons_r[g] += ro[2]
+    finally:
+        ragged.reset_arena()

@@ -1,0 +1,469 @@
+"""Mixed-width ragged arena: width-agnostic pages, in-pool
+re-centering, and learned placement.
+
+Core claims under test: (1) gang members with *different* band widths
+run through one stride-masked ragged kernel byte-identical to their
+solo ``run_extend`` paths, at the serve layer (all three engines) and
+at the kernel seam directly; (2) a band grow (E doubling) re-centers a
+resident member in pool — it keeps ganging at its new per-row stride —
+while a width outgrowing the pool evicts cleanly; (3) exhaustion /
+degradation semantics are unchanged by stride-mixed page runs; (4)
+frontier gangs of heterogeneous-W searches stay byte-identical to
+M=1; (5) learned placement follows perfdb substrate medians when the
+history is warm and falls back to the static read-count threshold when
+cold, one-sided, or disabled.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder, ConsensusDWFA
+from waffle_con_tpu.config import CdwfaConfig
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import perfdb
+from waffle_con_tpu.ops import ragged
+from waffle_con_tpu.ops.jax_scorer import JaxScorer
+from waffle_con_tpu.serve import (
+    ConsensusService,
+    JobRequest,
+    ServeConfig,
+)
+from waffle_con_tpu.serve import placement
+from waffle_con_tpu.serve.placement import PlacementPolicy
+from waffle_con_tpu.serve.service import _build_engine
+from waffle_con_tpu.utils.example_gen import generate_test
+from waffle_con_tpu.utils.fixtures import (
+    load_dual_fixture,
+    load_priority_fixture,
+)
+
+pytestmark = pytest.mark.serve
+
+BIG = 10**9
+
+#: band seeds landing on three distinct pow2 E geometries under the
+#: default pool (E=32): E 8 / 16 / 32 -> natural W 18 / 34 / 66
+BAND_SEEDS = (8, 12, 24)
+
+
+@pytest.fixture
+def arena_env(monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED", "1")
+    ragged.reset_arena()
+    yield
+    ragged.reset_arena()
+
+
+def _jax_cfg(band=None, **kw):
+    b = CdwfaConfigBuilder().backend("jax")
+    if band is not None:
+        b = b.initial_band(band)
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _band_cfg(band):
+    return CdwfaConfig(initial_band=band)
+
+
+# ------------------------------------------------- serve-layer parity
+
+
+def _mixed_width_requests():
+    """Nine jax jobs across all three engines, band seeds cycling
+    through three distinct pow2 E geometries — only the stride-masked
+    kernel can gang them."""
+    requests = []
+    fcfg = _jax_cfg(band=BAND_SEEDS[0], min_count=2)
+    sequences, _ = load_dual_fixture("dual_001", True, fcfg.consensus_cost)
+    requests.append(
+        JobRequest(kind="dual", reads=tuple(sequences), config=fcfg)
+    )
+    chains, _ = load_priority_fixture(
+        "priority_001", True, fcfg.consensus_cost
+    )
+    requests.append(
+        JobRequest(
+            kind="priority",
+            reads=tuple(tuple(c) for c in chains),
+            config=_jax_cfg(band=BAND_SEEDS[1], min_count=2),
+        )
+    )
+    shapes = [(4, 90), (7, 140), (3, 60), (10, 200), (5, 120),
+              (6, 180), (8, 100)]
+    for seed, (n, length) in enumerate(shapes):
+        _, reads = generate_test(n, length, 6, 0.02, seed=seed)
+        cfg = _jax_cfg(
+            band=BAND_SEEDS[seed % 3], min_count=max(2, n // 4)
+        )
+        requests.append(
+            JobRequest(kind="single", reads=tuple(reads), config=cfg)
+        )
+    return requests
+
+
+def test_mixed_width_serve_parity_all_engines(arena_env):
+    requests = _mixed_width_requests()
+    expected = [_build_engine(r).consensus() for r in requests]
+
+    with ConsensusService(
+        ServeConfig(workers=8, batch_window_s=0.05, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        results = [h.result(timeout=300) for h in handles]
+        stats = svc.stats()
+
+    for got, want in zip(results, expected):
+        assert got == want, "mixed-W served job diverged from serial"
+    assert stats["jobs"]["failed"] == 0
+
+    arena = stats["ragged"]
+    assert arena["mixed_w"] is True
+    assert arena["groups"] >= 1
+    assert arena["members"] >= 2
+    assert arena["pages_used"] == 0
+    assert arena["member_store_failures"] == 0
+
+
+# ------------------------------------------------ direct kernel parity
+
+
+def _mutated_reads(n, lo, hi, seed):
+    r = np.random.default_rng(seed)
+    base = r.integers(0, 4, size=int(r.integers(lo, hi))).astype(np.uint8)
+    reads = []
+    for _ in range(n):
+        b = base.copy()
+        m = r.random(len(b)) < 0.03
+        b[m] = r.integers(0, 4, int(m.sum())).astype(np.uint8)
+        reads.append(bytes(b))
+    return reads
+
+
+def _parity_rounds(solos, rags, jobs, rounds, max_steps=8):
+    """Drive ``rounds`` lockstep run_extend rounds through the gang and
+    the solo path, asserting byte/stats equality each round."""
+    hs_s = [s.root(np.ones(len(j), bool)) for s, j in zip(solos, jobs)]
+    hs_r = [s.root(np.ones(len(j), bool)) for s, j in zip(rags, jobs)]
+    cons_s = [b""] * len(jobs)
+    cons_r = [b""] * len(jobs)
+    for rnd in range(rounds):
+        solo_out = [
+            s.run_extend(h, c, BIG, BIG, 0, 2, False, max_steps,
+                         allow_records=False)
+            for s, h, c in zip(solos, hs_s, cons_s)
+        ]
+        args_list = [
+            (h, c, BIG, BIG, 0, 2, False, max_steps)
+            for h, c in zip(hs_r, cons_r)
+        ]
+        specs = []
+        for s, a in zip(rags, args_list):
+            spec = ragged.probe((s.ragged_run_probe, a, {}))
+            assert spec is not None, "eligible mixed-W member refused"
+            specs.append(spec)
+        ragged.run_group(specs)
+        rag_out = [s.run_extend(*a) for s, a in zip(rags, args_list)]
+        for g, (so, ro) in enumerate(zip(solo_out, rag_out)):
+            s_steps, s_code, s_app, s_stats, s_rec = so
+            r_steps, r_code, r_app, r_stats, r_rec = ro
+            ctx = f"round {rnd} job {g}"
+            assert (s_steps, s_code, s_app) == (r_steps, r_code, r_app), ctx
+            assert s_rec == [] and r_rec == []
+            np.testing.assert_array_equal(s_stats.eds, r_stats.eds, ctx)
+            np.testing.assert_array_equal(s_stats.occ, r_stats.occ, ctx)
+            np.testing.assert_array_equal(s_stats.split, r_stats.split, ctx)
+            np.testing.assert_array_equal(
+                s_stats.reached, r_stats.reached, ctx
+            )
+            if s_stats.fin is None:
+                assert r_stats.fin is None, ctx
+            else:
+                np.testing.assert_array_equal(s_stats.fin, r_stats.fin, ctx)
+            cons_s[g] += s_app
+            cons_r[g] += r_app
+
+
+def test_mixed_width_kernel_matches_solo(arena_env):
+    """Three members at three distinct band widths gang through one
+    stride-masked kernel call per round, byte/stats-identical to
+    solo."""
+    jobs = [
+        _mutated_reads(5, 80, 120, 1),
+        _mutated_reads(9, 150, 200, 2),
+        _mutated_reads(3, 40, 60, 3),
+    ]
+    solos = [JaxScorer(r, _band_cfg(b)) for r, b in zip(jobs, BAND_SEEDS)]
+    rags = [JaxScorer(r, _band_cfg(b)) for r, b in zip(jobs, BAND_SEEDS)]
+    widths = sorted(s._W for s in rags)
+    assert len(set(widths)) == 3, widths  # genuinely heterogeneous
+
+    _parity_rounds(solos, rags, jobs, rounds=4)
+
+    arena = ragged.get_arena()
+    st = arena.stats()
+    assert st["groups"] == 4
+    assert st["mean_occupancy"] == 3.0
+    assert st["mixed_w_groups"] == 4
+    # gang_rows counts the staged pool rows actually stepped (page runs
+    # include the scorers' pow2 row padding, so >= the raw read count)
+    assert st["gang_rows"] >= 4 * sum(len(j) for j in jobs)
+    assert st["mean_gang_rows"] == st["gang_rows"] / 4
+    for s in rags:
+        s.ragged_release()
+    assert arena.stats()["pages_used"] == 0
+
+
+def test_mixed_w_disabled_restores_equality_gate(arena_env, monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED_MIXED_W", "0")
+    ragged.reset_arena()
+    reads = _mutated_reads(4, 60, 90, 7)
+    narrow = JaxScorer(reads, _band_cfg(8))    # W=18 != pool W
+    matched = JaxScorer(reads, _band_cfg(24))  # W=66 == pool W (E=32)
+    arena = ragged.get_arena()
+    assert narrow._W != arena.W and matched._W == arena.W
+    h_n = narrow.root(np.ones(4, bool))
+    h_m = matched.root(np.ones(4, bool))
+    args = (h_n, b"", BIG, BIG, 0, 2, False, 8)
+    assert ragged.probe((narrow.ragged_run_probe, args, {})) is None
+    args = (h_m, b"", BIG, BIG, 0, 2, False, 8)
+    assert ragged.probe((matched.ragged_run_probe, args, {})) is not None
+    matched.ragged_release()
+
+
+# --------------------------------------------- re-centering under growth
+
+
+def test_recenter_under_growth_keeps_parity(arena_env):
+    """Doubling a resident member's band re-centers it in pool: it
+    keeps ganging at the new stride and stays byte-identical to a solo
+    scorer taken through the same growth."""
+    obs_metrics.enable_metrics(True)
+    obs_metrics.registry().reset()
+    try:
+        jobs = [
+            _mutated_reads(4, 70, 100, 11),
+            _mutated_reads(6, 120, 160, 12),
+        ]
+        bands = (8, 24)  # W 18 and 66
+        solos = [JaxScorer(r, _band_cfg(b)) for r, b in zip(jobs, bands)]
+        rags = [JaxScorer(r, _band_cfg(b)) for r, b in zip(jobs, bands)]
+
+        _parity_rounds(solos, rags, jobs, rounds=2)
+        arena = ragged.get_arena()
+        assert arena.stats()["groups"] == 2
+
+        # grow the narrow member on BOTH paths (E 8 -> 16, W 18 -> 34,
+        # still under the pool's 66): residency must survive
+        solos[0]._grow_e()
+        rags[0]._grow_e()
+        assert rags[0]._W == 34
+        st = arena.stats()
+        assert st["recenters"] == 1
+        assert st["releases"] == 0
+
+        _parity_rounds(solos, rags, jobs, rounds=2)
+        st = arena.stats()
+        assert st["groups"] == 4  # the grown member ganged again
+        assert st["mixed_w_groups"] == 4
+
+        snap = obs_metrics.registry().snapshot()
+        series = snap["waffle_ragged_recenter_total"]["series"]
+        assert sum(series.values()) == 1
+        for s in rags:
+            s.ragged_release()
+    finally:
+        obs_metrics.reset_metrics_enabled()
+        obs_metrics.registry().reset()
+
+
+def test_recenter_evicts_when_band_outgrows_pool(arena_env, monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED_E", "8")  # pool W = 18
+    ragged.reset_arena()
+    reads = _mutated_reads(4, 60, 90, 13)
+    s = JaxScorer(reads, _band_cfg(8))  # W = 18 == pool W
+    arena = ragged.get_arena()
+    assert arena.try_admit(s, job_id=1) is not None
+    assert arena.stats()["pages_used"] > 0
+
+    s._grow_e()  # W 18 -> 34 > pool's 18: classic eviction
+    st = arena.stats()
+    assert st["recenters"] == 0
+    assert st["releases"] == 1
+    assert st["pages_used"] == 0
+    # and the grown scorer is no longer gang-eligible
+    h = s.root(np.ones(4, bool))
+    args = (h, b"", BIG, BIG, 0, 2, False, 8)
+    assert ragged.probe((s.ragged_run_probe, args, {})) is None
+
+
+# ------------------------------------------- exhaustion with mixed runs
+
+
+def test_exhaustion_degrades_with_mixed_width_runs(arena_env, monkeypatch):
+    monkeypatch.setenv("WAFFLE_RAGGED_ROWS", "16")
+    monkeypatch.setenv("WAFFLE_RAGGED_PAGE", "8")
+    ragged.reset_arena()
+    _, reads = generate_test(8, 60, 6, 0.02, seed=21)
+    scorers = [
+        JaxScorer(tuple(reads), _band_cfg(b)) for b in (8, 24, 12)
+    ]
+    arena = ragged.get_arena()
+    assert arena.try_admit(scorers[0], job_id=1) is not None
+    assert arena.try_admit(scorers[1], job_id=2) is not None
+    assert arena.try_admit(scorers[2], job_id=3) is None  # pool full
+    assert arena.stats()["exhausted"] == 1
+
+    # releasing the wide member recycles its pages to the waiting one
+    arena.release_scorer(scorers[1])
+    assert arena.try_admit(scorers[2], job_id=3) is not None
+    arena.release_job(1)
+    arena.release_scorer(scorers[2])
+    st = arena.stats()
+    assert st["pages_used"] == 0
+    assert st["pages_free"] == st["pages_total"]
+
+
+def test_tiny_pool_mixed_width_serve_still_byte_identical(
+    arena_env, monkeypatch
+):
+    monkeypatch.setenv("WAFFLE_RAGGED_ROWS", "8")
+    monkeypatch.setenv("WAFFLE_RAGGED_PAGE", "8")
+    ragged.reset_arena()
+    requests = _mixed_width_requests()[2:6]
+    expected = [_build_engine(r).consensus() for r in requests]
+    with ConsensusService(
+        ServeConfig(workers=4, batch_window_s=0.02, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        results = [h.result(timeout=300) for h in handles]
+    assert results == expected
+
+
+# ---------------------------------------- frontier gang, heterogeneous W
+
+
+def _frontier_consensus(reads, m, band, monkeypatch):
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", str(m))
+    engine = ConsensusDWFA(_jax_cfg(band=band, min_count=2))
+    for r in reads:
+        engine.add_sequence(r)
+    result = [(c.sequence, c.scores) for c in engine.consensus()]
+    counters = dict(
+        engine.last_search_stats.get("scorer_counters", {})
+    )
+    return result, counters
+
+
+def test_frontier_gang_heterogeneous_w_peers(monkeypatch):
+    """Two searches with different natural band widths both speculate
+    through the shared kernel closure in one process, each
+    byte-identical to its M=1 run."""
+    workloads = []
+    for band, seed in ((8, 52300), (24, 52400)):
+        _, reads = generate_test(4, 300, 8, 0.02, seed=seed)
+        workloads.append((band, reads))
+    ganged_any = 0
+    for band, reads in workloads:
+        base, _ = _frontier_consensus(reads, 1, band, monkeypatch)
+        ganged, counters = _frontier_consensus(reads, 3, band, monkeypatch)
+        assert ganged == base, f"band {band} diverged under M=3"
+        ganged_any += counters.get("gang_groups", 0)
+    assert ganged_any >= 1  # speculation actually fired at some width
+
+
+# -------------------------------------------------- learned placement
+
+
+def _jax_request(n_reads):
+    return JobRequest(
+        kind="single",
+        reads=tuple(b"ACGTACGT" for _ in range(n_reads)),
+        config=_jax_cfg(),
+    )
+
+
+@pytest.fixture
+def learned_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("WAFFLE_PERFDB", str(tmp_path / "perfdb.jsonl"))
+    monkeypatch.setenv("WAFFLE_PLACEMENT_LEARNED", "1")
+    placement.reset_profile_cache()
+    yield
+    placement.reset_profile_cache()
+
+
+def test_learned_placement_cold_falls_back_to_threshold(learned_env):
+    pol = PlacementPolicy(large_read_threshold=64)
+    assert pol.classify(_jax_request(100)) == "mesh"
+    assert pol.classify(_jax_request(10)) == "arena"
+
+
+def test_learned_placement_warm_overrides_threshold(learned_env):
+    pol = PlacementPolicy(large_read_threshold=64)
+    # warm history says arena beats mesh for the 128-reads bucket
+    for _ in range(placement.MIN_PROFILE_SAMPLES):
+        placement.record_outcome("mesh", 100, 2.0)
+        placement.record_outcome("arena", 100, 0.5)
+    assert pol.classify(_jax_request(100)) == "arena"
+    # …but the 16-reads bucket stays cold: static threshold applies
+    assert pol.classify(_jax_request(10)) == "arena"
+    # flip the history: mesh now faster — the stamp change re-reads
+    for _ in range(2 * placement.MIN_PROFILE_SAMPLES):
+        placement.record_outcome("mesh", 100, 0.1)
+    assert pol.classify(_jax_request(100)) == "mesh"
+
+
+def test_learned_placement_one_sided_history_is_cold(learned_env):
+    pol = PlacementPolicy(large_read_threshold=64)
+    for _ in range(5 * placement.MIN_PROFILE_SAMPLES):
+        placement.record_outcome("arena", 100, 0.1)
+    # no mesh samples at all: never learned, threshold decides
+    assert pol.classify(_jax_request(100)) == "mesh"
+
+
+def test_learned_placement_disabled_ignores_history(
+    learned_env, monkeypatch
+):
+    for _ in range(placement.MIN_PROFILE_SAMPLES):
+        placement.record_outcome("mesh", 100, 2.0)
+        placement.record_outcome("arena", 100, 0.5)
+    monkeypatch.setenv("WAFFLE_PLACEMENT_LEARNED", "0")
+    pol = PlacementPolicy(large_read_threshold=64)
+    assert pol.classify(_jax_request(100)) == "mesh"
+
+
+def test_learned_placement_prefers_phase_profile_seconds(learned_env):
+    pol = PlacementPolicy(large_read_threshold=64)
+    # wall says mesh is slower, but the attributable phase time
+    # (host+device+transfer) says mesh is faster — phases win
+    for _ in range(placement.MIN_PROFILE_SAMPLES):
+        placement.record_outcome(
+            "mesh", 100, 9.0,
+            phases={"host_prep": 0.05, "device_compute": 0.1,
+                    "transfer": 0.05},
+        )
+        placement.record_outcome("arena", 100, 0.5)
+    assert pol.classify(_jax_request(100)) == "mesh"
+
+
+def test_service_records_placement_profiles(learned_env, arena_env):
+    """With the knob on, every done job appends one placement_profile
+    record carrying its substrate and reads bucket."""
+    requests = _mixed_width_requests()[2:5]
+    with ConsensusService(
+        ServeConfig(workers=2, batch_window_s=0.02, max_batch=8)
+    ) as svc:
+        handles = svc.submit_all(requests)
+        for h in handles:
+            h.result(timeout=300)
+    records = perfdb.load_records(kind=perfdb.PLACEMENT_KIND)
+    assert len(records) == len(requests)
+    for rec, req in zip(
+        sorted(records, key=lambda r: r["n_reads"]),
+        sorted(requests, key=lambda r: len(r.reads)),
+    ):
+        assert rec["substrate"] == "arena"  # no policy: nothing meshed
+        assert rec["n_reads"] == len(req.reads)
+        assert rec["reads_bucket"] == perfdb.reads_bucket(len(req.reads))
+        assert rec["value"] > 0

@@ -8,8 +8,10 @@ into device occupancy: alongside the in-hand node's ``run_extend`` it
 gangs the next-best M−1 queued branches (``SetPriorityQueue.peek_top``)
 through the same ``_j_run_ragged`` segment-reduce kernel the serving
 arena compiles.  Branches of one search share the scorer — hence band
-width — so the arena's W-equality gate holds trivially and a search
-self-gangs even outside the serving stack.
+width — so the kernel's per-row stride is uniform within a self-gang
+(the serving arena additionally mixes strides across jobs; see
+``WAFFLE_RAGGED_MIXED_W``) and a search self-gangs even outside the
+serving stack.
 
 Nothing here affects results: peers' post-run states are held as
 consume-once :class:`~waffle_con_tpu.ops.ragged._SpecInjected` deposits

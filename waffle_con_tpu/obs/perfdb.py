@@ -56,6 +56,11 @@ EVIDENCE_SCHEMA = 2
 
 DEFAULT_RELPATH = os.path.join("evidence", "perfdb.jsonl")
 
+#: record kind for per-job placement outcomes (serve/placement.py
+#: learns mesh-vs-arena routing from these; written only under
+#: ``WAFFLE_PLACEMENT_LEARNED`` so the checked-in history stays clean)
+PLACEMENT_KIND = "placement_profile"
+
 #: evidence fields every mode must carry (ci.sh bench smoke asserts
 #: "metric"; the rest are the cross-mode invariants)
 EVIDENCE_REQUIRED = ("metric", "value", "unit", "schema")
@@ -72,6 +77,7 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
     "serve-mix": (
         "parity", "ragged_occupancy", "compiles_ragged",
         "ragged_stats", "bucketed_run_occupancy", "jobs_per_s_ragged",
+        "mixed_w",
     ),
     "storm": (
         "parity", "jobs_per_s", "jobs_per_s_single",
@@ -179,6 +185,61 @@ def rolling_baseline(records: List[Dict], metric: Optional[str] = None,
     n = len(values)
     mid = n // 2
     return values[mid] if n % 2 else (values[mid - 1] + values[mid]) / 2
+
+
+# -- placement profiles (serve/placement.py learned routing) ----------
+
+
+def reads_bucket(n_reads: int) -> int:
+    """Pow2 geometry bucket a placement profile is keyed by — the same
+    rounding the scorers apply to their read axis, so jobs that compile
+    to the same geometry share one rolling history."""
+    n = max(int(n_reads), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def decision_seconds(record: Dict) -> Optional[float]:
+    """The seconds a placement decision compares for one profile
+    record: the attributable dispatch time (``host_prep +
+    device_compute + transfer`` from the record's ``phases`` dict) when
+    phase profiling captured it, else the job wall seconds in
+    ``value``.  ``None`` for a record carrying neither."""
+    phases = record.get("phases")
+    if isinstance(phases, dict):
+        parts = [phases.get(k)
+                 for k in ("host_prep", "device_compute", "transfer")]
+        if all(isinstance(p, (int, float)) for p in parts):
+            return float(sum(parts))
+    value = record.get("value")
+    return float(value) if isinstance(value, (int, float)) else None
+
+
+def substrate_medians(records: List[Dict], bucket: int,
+                      window: int = 32) -> Dict[str, Dict]:
+    """Rolling per-substrate decision-seconds medians for one reads
+    bucket: ``{"mesh": {"n": ..., "median": ...}, "arena": {...}}``
+    with absent substrates omitted.  ``records`` is a
+    :data:`PLACEMENT_KIND` record list (oldest first, as
+    :func:`load_records` returns); ``window`` bounds how much history
+    per substrate counts."""
+    out: Dict[str, Dict] = {}
+    for substrate in ("mesh", "arena"):
+        values = [
+            s for s in (
+                decision_seconds(r) for r in records
+                if r.get("kind") == PLACEMENT_KIND
+                and r.get("substrate") == substrate
+                and r.get("reads_bucket") == bucket
+            ) if s is not None
+        ][-window:]
+        if values:
+            values.sort()
+            n = len(values)
+            mid = n // 2
+            median = (values[mid] if n % 2
+                      else (values[mid - 1] + values[mid]) / 2)
+            out[substrate] = {"n": n, "median": median}
+    return out
 
 
 # -- bench evidence schema --------------------------------------------

@@ -2772,9 +2772,16 @@ class JaxScorer(WavefrontScorer):
     def _grow_e(self) -> None:
         """Double the band half-width and replay all branches at the new
         geometry (band values outside the old window are unknown, so the
-        recorded consensus is re-scanned on device)."""
+        recorded consensus is re-scanned on device).  An arena-resident
+        scorer is re-centered in pool rather than evicted: its staged
+        reads are untouched by a band change, so it stays gang-eligible
+        at the new per-row stride while the new width fits the pool's
+        (see ``ops.ragged.recenter_scorer``)."""
+        from waffle_con_tpu.ops import ragged as _ragged
+
         self._spec_drop()
         self._E *= 2
+        _ragged.recenter_scorer(self)
         self.counters["grow_e_events"] += 1
         self.counters["replayed_cols"] += int(self._state["clen"].max())
         st = self._state
@@ -3385,7 +3392,10 @@ class JaxScorer(WavefrontScorer):
                     self.symtab[inj.ids[:steps]].astype(np.uint8).tobytes()
                 )
             if code == 5:
-                self._grow_e()  # band now mismatches the pool: solo next
+                # grow + in-pool re-center: the next probe gangs again
+                # at the doubled per-row stride (only a width outgrowing
+                # the pool evicts)
+                self._grow_e()
             return steps, code, appended, self._stats_np(inj.stats), []
         self._invalidate_root_stats()
         rec = _phases.current()

@@ -37,10 +37,21 @@ Byte-identity with the serial path:
 * the kernel is the single-column (K=1) ``_j_run`` body with every
   per-branch reduction replaced by a segment-reduce keyed by job — the
   speculative-K contract already guarantees K=1 ≡ any K;
-* members are only admitted when their band width equals the pool's
-  (the serve layer floors job geometry to the pool's, see
-  ``geometry_hint``), so state moves by straight row copy — no
-  re-centering, no value changes;
+* pages are **width-agnostic**: each pool row carries its member's band
+  width as a per-row stride (``wrow``), the kernel masks every column
+  past it to the ``INF`` sentinel before any reduce, and the band-index
+  arithmetic uses the per-row half-width — so one compiled pool
+  geometry serves members of *different* band widths and a row's
+  columns ``[0, wrow)`` compute exactly what the member's own solo
+  kernel at width ``wrow`` would (columns past it stay inert).  State
+  moves by width-sliced row copy — no re-centering, no value changes.
+  ``WAFFLE_RAGGED_MIXED_W=0`` restores the historical band-width
+  equality gate (A/B lever; the stride path is the default);
+* a member whose band grows mid-run (E doubles on overflow) is
+  **re-centered in pool** (:func:`recenter_scorer`): its page run and
+  staged reads are untouched — only its now-stale deposits drop — so a
+  long-running job stays gang-eligible for its whole life while its
+  new width still fits the pool's;
 * record absorption is force-disabled (``allow_records=0`` semantics:
   reached states stop with code 2, which the engine already handles),
   trading extra dispatches for exactness;
@@ -98,6 +109,14 @@ def enabled() -> bool:
     )
 
 
+def mixed_w_enabled() -> bool:
+    """Width-agnostic pages (``WAFFLE_RAGGED_MIXED_W``, default on):
+    members of different band widths share one gang via the per-row W
+    stride.  Off restores the historical W-equality eligibility gate."""
+    raw = envspec.get_raw("WAFFLE_RAGGED_MIXED_W", "1")
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
 # ======================================================================
 # serve-scope geometry hint.  Constant-compile-count story: every serve
 # job built inside the scope floors its scorer geometry up to the pool's
@@ -136,25 +155,33 @@ def geometry_hint() -> Optional[GeometryHint]:
     with ragged dispatch disabled — the bucketed baseline keeps its
     natural per-shape geometry, recompiles and all).
 
-    Only the band half-width and the consensus axis are floored.  W
-    equality is the arena's hard gang-eligibility requirement, and E is
-    the one axis pow2 growth would otherwise scatter across jobs (it
-    doubles adaptively at runtime).  C is floored because eligibility
-    demands ``len(consensus) + max_steps + 2 < C`` *at probe time* —
-    the solo wrapper grows C lazily mid-run, so a natural C of 512
-    against step budgets in the hundreds would veto nearly every gang;
-    the cons axis is O(C) scatter work per step, not [R, W] row work,
-    so the floor is cheap.  R/L stay natural — the gather/scatter
-    handles any per-member R/L, and flooring them was measured to cost
-    far more on every SOLO dispatch of small jobs (4x row work at
-    R 16->64) than it saved in compile-key sharing: pow2 quantization
-    inside the pool envelope already bounds the distinct kernel keys by
-    a pool-determined constant, not by the number of distinct job
-    shapes."""
+    Only the consensus axis is always floored (and the band half-width
+    when mixed-width ganging is disabled).  C is floored because
+    eligibility demands ``len(consensus) + max_steps + 2 < C`` *at
+    probe time* — the solo wrapper grows C lazily mid-run, so a natural
+    C of 512 against step budgets in the hundreds would veto nearly
+    every gang; the cons axis is O(C) scatter work per step, not
+    [R, W] row work, so the floor is cheap.  R/L stay natural — the
+    gather/scatter handles any per-member R/L, and flooring them was
+    measured to cost far more on every SOLO dispatch of small jobs (4x
+    row work at R 16->64) than it saved in compile-key sharing: pow2
+    quantization inside the pool envelope already bounds the distinct
+    kernel keys by a pool-determined constant, not by the number of
+    distinct job shapes.
+
+    E follows the same logic since the width-agnostic arena: the
+    per-row W stride makes any ``W <= pool W`` gang-eligible, so
+    flooring E would only inflate every solo dispatch's [R, W] row work
+    (quadratic in E for the replay) with nothing bought.  Jobs keep
+    their natural band; pow2 E growth ladders through a handful of solo
+    compile keys bounded by ``log2(pool E)``.  Only with
+    ``WAFFLE_RAGGED_MIXED_W=0`` — where W equality is back to being the
+    gang gate — is E floored to the pool's."""
     if not getattr(_TLS, "serving", 0) or not enabled():
         return None
     cfg = ArenaConfig.from_env()
-    return GeometryHint(band=cfg.band_e, rows=0, length=0, cons=cfg.cons_len)
+    band = 0 if mixed_w_enabled() else cfg.band_e
+    return GeometryHint(band=band, rows=0, length=0, cons=cfg.cons_len)
 
 
 # ======================================================================
@@ -358,6 +385,10 @@ class BandArena:
             "admits": 0, "releases": 0, "exhausted": 0,
             "injected_consumed": 0, "injected_dropped": 0,
             "member_store_failures": 0,
+            # width-agnostic-page accounting: gangs whose members span
+            # >= 2 distinct band widths, total active rows stepped, and
+            # in-pool band re-centerings (grown members kept resident)
+            "mixed_w_groups": 0, "gang_rows": 0, "recenters": 0,
         }
         self._reads = None   # [ROWS, L] int16 device, staged lazily
         self._rlen = None    # [ROWS] int32 device
@@ -385,9 +416,14 @@ class BandArena:
     # -- eligibility + residency ---------------------------------------
 
     def eligible(self, scorer, vals: Dict) -> bool:
-        """Geometry gate for one probed member.  Band-width equality is
-        the byte-identity keystone: state then moves by straight row
-        copy.  The consensus-capacity check mirrors the solo wrapper's
+        """Geometry gate for one probed member.  With width-agnostic
+        pages (the default) the pool band width is a *cap*, not an
+        equality: any member with ``W <= pool W`` gangs, its rows
+        running at their own per-row stride inside the pool envelope
+        (byte-identity holds because the kernel masks every column past
+        the stride to INF before any reduce).  With
+        ``WAFFLE_RAGGED_MIXED_W=0`` the historical equality gate is
+        back.  The consensus-capacity check mirrors the solo wrapper's
         grow condition so an injected run never needed a grow."""
         try:
             n = scorer.num_reads
@@ -395,7 +431,10 @@ class BandArena:
                 return False
             if getattr(scorer, "_shardings", None) is not None:
                 return False
-            if scorer._W != self.W:
+            if mixed_w_enabled():
+                if scorer._W > self.W:
+                    return False
+            elif scorer._W != self.W:
                 return False
             if scorer.num_symbols > self.A:
                 return False
@@ -468,6 +507,40 @@ class BandArena:
             ]:
                 self._release_key(key)
 
+    def recenter_scorer(self, scorer) -> bool:
+        """In-pool band re-centering: the scorer's band just grew (E
+        doubled on overflow) or otherwise re-centered, so any held
+        deposits were computed at the old width and are stale — but its
+        page run and staged reads are untouched by a band change, so
+        residency survives and the member gangs again on its next probe
+        at the new per-row stride.  Only a width outgrowing the pool's
+        evicts (the stride is a cap); returns True while the scorer is
+        still resident."""
+        with self._lock:
+            key = id(scorer)
+            res = self._resident.get(key)
+            if res is None:
+                return False
+            stale = [k for k in self._injected if k[0] == key]
+            for k in stale:
+                self._injected.pop(k, None)
+                self._counters["injected_dropped"] += 1
+            try:
+                if scorer._W > self.W or not mixed_w_enabled():
+                    # the pool can no longer express this band (or the
+                    # equality gate is back on): classic eviction
+                    self._release_key(key)
+                    return False
+            except AttributeError:
+                self._release_key(key)
+                return False
+            self._counters["recenters"] += 1
+            if obs_metrics.metrics_enabled():
+                obs_metrics.registry().counter(
+                    "waffle_ragged_recenter_total"
+                ).inc()
+            return True
+
     # -- injections ----------------------------------------------------
 
     def take_injected(self, scorer, h: int) -> Optional[_Injected]:
@@ -494,7 +567,22 @@ class BandArena:
         per-branch fold replaced by a segment-reduce keyed by the
         per-row job id (``seg``).  Static shapes are pool-only
         (``ROWS x W x C x (G+1) x A``), so exactly one compilation
-        serves every member mix."""
+        serves every member mix.
+
+        Width-agnostic pages: ``wrow`` carries each row's member band
+        width (a traced ``[ROWS] int32`` — no new compile keys), the
+        per-row half-width ``erow = (wrow - 2) // 2`` replaces the old
+        pool-wide scalar in the band-index arithmetic and the overflow
+        checks, and every column at or past a row's stride is forced to
+        the ``INF`` sentinel *before* the column-min / row-end reduces.
+        With that forcing, a row's columns ``[0, wrow)`` compute
+        exactly the member's own solo kernel at width ``wrow``: the
+        delete shift at column ``wrow - 1`` reads the forced INF
+        (matching the solo kernel's appended INF fill), the insertion
+        prefix-min only ever flows left-to-right so junk in the padding
+        columns cannot reach a valid column, and the reduces see INF
+        from padding — members of different widths share one gang
+        byte-identically."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -505,11 +593,10 @@ class BandArena:
 
         @partial(jax.jit, static_argnames=("A", "cols"))
         def _j_run_ragged(reads, rlen, D0, e0, rmin0, er0, off, act, seg,
-                          cons0, clen0, jp, A, cols=1):
+                          wrow, cons0, clen0, jp, A, cols=1):
             ROWS, W = D0.shape
             L = reads.shape[1]
             G1, C = cons0.shape
-            E = jnp.int32((W - 2) // 2)
             EPS = VOTE_EPS
 
             in_group = jp[:, 0].astype(bool)
@@ -528,6 +615,10 @@ class BandArena:
             et_r = et[seg]
             t = jnp.arange(W, dtype=jnp.int32)[None, :]
             gi = jnp.arange(G1, dtype=jnp.int32)
+            # per-row band stride: half-width for the index arithmetic,
+            # column mask for the sentinel forcing
+            erow = (wrow - 2) // 2
+            wmask = t < wrow[:, None]
 
             def seg_sum(x):
                 return jnp.zeros(
@@ -545,7 +636,8 @@ class BandArena:
             def col_step(D, e, rmin, er, jnew_r, sym_r):
                 # row-wise _col_step_w: identical formulas with the
                 # per-branch scalars (sym/wc/et/jnew) per-row vectors
-                i_new = jnew_r[:, None] - off[:, None] - E + t
+                # and the per-row band half-width replacing the pool's
+                i_new = jnew_r[:, None] - off[:, None] - erow[:, None] + t
                 bchar = jnp.take_along_axis(
                     reads, jnp.clip(i_new - 1, 0, L - 1), axis=1
                 )
@@ -557,12 +649,17 @@ class BandArena:
                     [D[:, 1:], jnp.full_like(D[:, :1], INF)], axis=1
                 ) + 1
                 base = jnp.minimum(diag, dele)
-                invalid = (i_new < 0) | (i_new > rlen[:, None])
+                invalid = (i_new < 0) | (i_new > rlen[:, None]) | ~wmask
                 base = jnp.where(invalid, jnp.int32(INF), base)
                 chain = _cummin_rows(base - t)
                 Dn = jnp.minimum(
                     jnp.minimum(base, chain + t), jnp.int32(INF)
                 )
+                # force the columns past a row's stride back to the
+                # sentinel BEFORE any reduce: the insertion chain puts
+                # finite values there (chain + t), and the row-end
+                # reduce below would otherwise absorb them into rmin
+                Dn = jnp.where(wmask, Dn, jnp.int32(INF))
                 colmin = Dn.min(axis=1)
                 rend = jnp.where(
                     i_new == rlen[:, None], Dn, jnp.int32(INF)
@@ -591,12 +688,12 @@ class BandArena:
                 # columns past a member's real alphabet are structurally
                 # zero — inert for every decision below)
                 clen_r = clen[seg]
-                i = clen_r[:, None] - off[:, None] - E + t
+                i = clen_r[:, None] - off[:, None] - erow[:, None] + t
                 vchar = jnp.take_along_axis(
                     reads, jnp.clip(i, 0, L - 1), axis=1
                 )
                 tip = (
-                    act[:, None] & (D <= e[:, None])
+                    act[:, None] & (D <= e[:, None]) & wmask
                     & (i >= 0) & (i < rlen[:, None])
                 )
                 onehot = (
@@ -680,7 +777,7 @@ class BandArena:
                 D2, e2, rmin2, er2 = col_step(
                     D, e, rmin, er, clen2[seg], sym[seg]
                 )
-                ovf = seg_any(act & (e2 >= E))
+                ovf = seg_any(act & (e2 >= erow))
                 commit = live & (code_new == 0) & ~ovf
                 code = jnp.where(
                     ~live, code,
@@ -708,7 +805,7 @@ class BandArena:
             Df, ef, rminf, erf = col_step(
                 D0, e0, rmin0, er0, (clen0 + 1)[seg], first_sym[seg]
             )
-            fovf = seg_any(act & (ef >= E))
+            fovf = seg_any(act & (ef >= erow))
             fcommit = force & ~fovf
             code_init = jnp.where(force & fovf, 5, 0).astype(jnp.int32)
             cpos0 = jnp.clip(clen0, 0, C - 1)
@@ -745,7 +842,7 @@ class BandArena:
             )
             eds, occ, split, reached = stats_rows(D, e, rmin, er, clen)
             fin = jnp.maximum(e, rmin)
-            fin_ovf = seg_any(act & (fin >= E))
+            fin_ovf = seg_any(act & (fin >= erow))
             fin_r = jnp.where(act, jnp.minimum(fin, INF), 0)
             return (D, e, rmin, er, cons, clen, steps, code, iters,
                     eds, occ, split, reached, fin_r, fin_ovf)
@@ -830,6 +927,7 @@ class BandArena:
         off = np.zeros(P, np.int32)
         act = np.zeros(P, bool)
         seg = np.full(P, G, np.int32)
+        wrow = np.full(P, self.W, np.int32)
         cons = np.zeros((G1, self.C), np.int32)
         clen = np.zeros(G1, np.int32)
         jp = np.zeros((G1, _JP_COLS), np.int32)
@@ -839,14 +937,21 @@ class BandArena:
             scorer, vals = spec.scorer, spec.vals
             if int(ld[5]) != len(vals["consensus"]):
                 continue  # engine/ledger desync: solo path decides
+            wm = int(scorer._W)
+            if wm > self.W:
+                continue  # grew past the pool since probe: solo decides
             ns = min(len(rows), scorer._R)
             rs = rows[:ns]
-            D[rs] = ld[0][:ns]
+            # width-sliced gather: the member's [ns, wm] state lands in
+            # the pool rows' first wm columns; the padding columns keep
+            # the INF fill the kernel's stride mask re-asserts each step
+            D[rs, :wm] = ld[0][:ns]
             e[rs] = ld[1][:ns]
             rmin[rs] = ld[2][:ns]
             er[rs] = ld[3][:ns]
             off[rs] = scorer._off_host[slot][:ns]
             act[rs] = scorer._act_host[slot][:ns]
+            wrow[rs] = wm
             g = len(live)
             seg[rows] = g
             cc = min(scorer._C, self.C)
@@ -869,7 +974,7 @@ class BandArena:
                 int(wc_int),
                 int(bool(cfg.allow_early_termination)),
             )
-            live.append(((spec, rows, slot), ld, ns))
+            live.append(((spec, rows, slot), ld, ns, wm))
         if len(live) < 2:
             return []
 
@@ -882,7 +987,7 @@ class BandArena:
         with _phases.device_scope(rec):
             out_dev = self._kernel(
                 self._reads[:P], self._rlen[:P], D, e, rmin, er, off,
-                act, seg, cons, clen, jp, A=self.A,
+                act, seg, wrow, cons, clen, jp, A=self.A,
             )
             if rec is not None:
                 # profiling fences the async dispatch so the device_get
@@ -895,14 +1000,17 @@ class BandArena:
 
         keys: List[Tuple[int, int]] = []
         n_members = len(live)
-        for g, ((spec, rows, slot), ld, ns) in enumerate(live):
+        n_rows = sum(ns for _m, _ld, ns, _wm in live)
+        widths = {wm for _m, _ld, _ns, wm in live}
+        for g, ((spec, rows, slot), ld, ns, wm) in enumerate(live):
             scorer = spec.scorer
             rs = rows[:ns]
             try:
-                # store back: kernel rows overwrite the member's first
-                # ns state rows, the tail keeps its loaded values
+                # store back: the kernel rows' first wm columns (the
+                # member's stride) overwrite the member's first ns
+                # state rows, the tail keeps its loaded values
                 Dn = np.array(ld[0])
-                Dn[:ns] = oD[rs]
+                Dn[:ns] = oD[rs, :wm]
                 en = np.array(ld[1]); en[:ns] = oe[rs]
                 rn = np.array(ld[2]); rn[:ns] = ormin[rs]
                 ern = np.array(ld[3]); ern[:ns] = oer[rs]
@@ -955,11 +1063,23 @@ class BandArena:
             self._counters["occupancy_max"] = max(
                 self._counters["occupancy_max"], n_members
             )
+            self._counters["gang_rows"] += n_rows
+            if len(widths) > 1:
+                self._counters["mixed_w_groups"] += 1
         if obs_metrics.metrics_enabled():
-            obs_metrics.registry().histogram(
+            reg = obs_metrics.registry()
+            reg.histogram(
                 "waffle_ragged_occupancy",
                 buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
             ).observe(n_members)
+            # stride-mixed gangs: occupancy alone under-reports device
+            # utilization when member row counts differ, so publish the
+            # actual rows stepped and the width mix alongside it
+            reg.histogram(
+                "waffle_ragged_gang_rows",
+                buckets=obs_metrics.DEFAULT_COUNT_BUCKETS,
+            ).observe(n_rows)
+            reg.gauge("waffle_ragged_gang_widths").set(len(widths))
         return keys
 
     # -- introspection -------------------------------------------------
@@ -971,6 +1091,7 @@ class BandArena:
         return {
             "active": True,
             "enabled": enabled(),
+            "mixed_w": mixed_w_enabled(),
             "rows": self.rows,
             "page_rows": self.page_rows,
             "pages_total": self.pages.n_pages,
@@ -979,6 +1100,7 @@ class BandArena:
             "band_e": self.E,
             "gang": self.gang,
             "mean_occupancy": (c["members"] / groups) if groups else 0.0,
+            "mean_gang_rows": (c["gang_rows"] / groups) if groups else 0.0,
             **c,
         }
 
@@ -1010,8 +1132,11 @@ class FrontierGang:
     ONE search through the shared ragged kernel in a single dispatch.
 
     Branches of one search share the scorer — hence band width — so the
-    arena's W-equality byte-identity gate holds trivially and a search
-    self-gangs even outside the serving stack.  Member ``g`` occupies
+    per-row W stride is uniform here (the kernel's stride mask
+    degenerates to all-true and the self-gang is byte-identical to the
+    pre-stride kernel by construction); scorers of *different* natural
+    widths still share the one process-wide kernel closure, each
+    compiling only its own ``W`` axis.  Member ``g`` occupies
     pool rows ``g*R .. g*R+R-1`` over the scorer's reads tiled ``P/R``
     times, so the exact segment-reduce kernel the serving arena
     compiles also serves the self-gang (one extra specialization per
@@ -1161,6 +1286,8 @@ class FrontierGang:
         off = np.zeros(P, np.int32)
         act = np.zeros(P, bool)
         seg = np.full(P, G, np.int32)
+        # one scorer, one band width: the stride axis is uniform
+        wrow = np.full(P, W, np.int32)
         cons = np.zeros((G1, C), np.int32)
         clen = np.zeros(G1, np.int32)
         jp = np.zeros((G1, _JP_COLS), np.int32)
@@ -1209,8 +1336,8 @@ class FrontierGang:
         )
         with _phases.device_scope(rec):
             out_dev = self._kernel(
-                reads_t, rlen_t, D, e, rmin, er, off, act, seg, cons,
-                clen, jp, A=A, cols=int(cols),
+                reads_t, rlen_t, D, e, rmin, er, off, act, seg, wrow,
+                cons, clen, jp, A=A, cols=int(cols),
             )
             if rec is not None:
                 out_dev = jax.block_until_ready(out_dev)
@@ -1294,8 +1421,11 @@ def frontier_gang_for(scorer) -> FrontierGang:
 def serving_active() -> bool:
     """True inside a ``serve_scope`` — the coalescing dispatcher owns
     batching there, so engines must not self-gang (a frontier dispatch
-    would race the cross-job ragged pass over the same slots)."""
-    return getattr(_TLS, "serving", None) is not None
+    would race the cross-job ragged pass over the same slots).  The
+    nesting counter (not mere attribute presence — an exited scope
+    leaves it at 0) decides, so a thread that once served a job gets
+    its self-ganging back afterwards."""
+    return bool(getattr(_TLS, "serving", 0))
 
 
 # ======================================================================
@@ -1451,6 +1581,24 @@ def release_scorer(scorer) -> None:
         gang.drop_all()
     for a in _all_arenas():
         a.release_scorer(scorer)
+
+
+def recenter_scorer(scorer) -> bool:
+    """Band geometry changed (E doubled / re-centered): drop the
+    scorer's stale deposits everywhere but KEEP its arena residency —
+    the page run holds reads, which a band change does not touch, so
+    the member re-gangs at its new per-row stride on the next probe
+    instead of paying release + re-admission (or, pre-stride, falling
+    solo forever).  Returns True while the scorer is still resident in
+    some arena (False: evicted — the new width outgrew the pool)."""
+    gang = getattr(scorer, "_frontier_gang", None)
+    if gang is not None:
+        gang.drop_all()
+    resident = False
+    for a in _all_arenas():
+        if a.recenter_scorer(scorer):
+            resident = True
+    return resident
 
 
 def release_job(job_id, arena: Optional[BandArena] = None) -> None:

@@ -352,7 +352,8 @@ class BatchingDispatcher:
 
     def _execute(self, batch: List[_DispatchRequest]) -> None:
         # ragged pass FIRST: gang eligible run_extend dispatches from
-        # *different* buckets into single arena kernel calls.  Each
+        # *different* buckets — and, with width-agnostic pages,
+        # different band widths — into single arena kernel calls.  Each
         # ganged member's result is deposited as a consume-once
         # injection that its ordinary fn() below returns instantly, so
         # execution order, tracing, supervision and error delivery are

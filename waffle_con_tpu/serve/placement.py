@@ -24,18 +24,130 @@ shard count is clamped to the available device pool (the replica's
 the cached probe) and rounded down to a power of two so it always
 divides the scorer's pow2-padded read count.  Below 2 effective
 shards the job simply stays on the arena path.
+
+**Learned placement** (``WAFFLE_PLACEMENT_LEARNED=1``): instead of
+the hand-set ``large_read_threshold``, :meth:`PlacementPolicy.classify`
+consults the perfdb — the service appends one
+``placement_profile`` record per finished job (substrate, pow2 reads
+bucket, wall seconds, phase breakdown when profiling is on), and the
+classifier compares rolling per-bucket medians of the two substrates'
+decision seconds (:func:`waffle_con_tpu.obs.perfdb.decision_seconds`:
+host+device+transfer when profiled, else wall).  The learned decision
+applies only when BOTH substrates have at least
+:data:`MIN_PROFILE_SAMPLES` records in the job's bucket; cold or
+one-sided history falls back to the static threshold, so the knob can
+never strand a fresh deployment.  Profiles are re-read only when the
+database file's (mtime, size) stamp changes — steady-state decisions
+cost a dict lookup, not a file parse.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+import os
+from typing import Dict, List, Optional
 
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.obs import perfdb
 from waffle_con_tpu.serve.job import JobRequest
+from waffle_con_tpu.utils import envspec
+
+#: both substrates need this many profile records in a job's reads
+#: bucket before the learned decision overrides the static threshold
+MIN_PROFILE_SAMPLES = 3
+
+
+def learned_enabled() -> bool:
+    """``WAFFLE_PLACEMENT_LEARNED`` — learn mesh-vs-arena routing from
+    perfdb placement profiles (default off)."""
+    return envspec.flag("WAFFLE_PLACEMENT_LEARNED")
 
 
 def _pow2_floor(n: int) -> int:
     return 1 << (n.bit_length() - 1) if n > 0 else 0
+
+
+class _ProfileCache:
+    """Placement-profile history, cached on the perfdb file stamp.
+
+    One process-wide instance backs every policy: profiles are keyed
+    by the database *path* so tests pointing ``WAFFLE_PERFDB`` at a
+    tmpfile never see another test's history, and the (mtime, size)
+    stamp invalidates the cache when the service appends new records.
+    """
+
+    def __init__(self) -> None:
+        self._lock = lockcheck.make_lock("placement.profiles")
+        self._stamp: Optional[tuple] = None
+        self._records: List[Dict] = []
+        self._medians: Dict[int, Dict[str, Dict]] = {}
+
+    def decide(self, bucket: int) -> Optional[str]:
+        """``"mesh"`` / ``"arena"`` when the history is warm enough to
+        choose, else ``None`` (caller falls back to the threshold)."""
+        medians = self._bucket_medians(bucket)
+        mesh = medians.get("mesh")
+        arena = medians.get("arena")
+        if (mesh is None or arena is None
+                or mesh["n"] < MIN_PROFILE_SAMPLES
+                or arena["n"] < MIN_PROFILE_SAMPLES):
+            return None
+        return "mesh" if mesh["median"] < arena["median"] else "arena"
+
+    def _bucket_medians(self, bucket: int) -> Dict[str, Dict]:
+        path = perfdb.default_path()
+        try:
+            st = os.stat(path)
+            stamp = (path, st.st_mtime_ns, st.st_size)
+        except OSError:
+            stamp = (path, None, None)
+        with self._lock:
+            if stamp != self._stamp:
+                self._records = perfdb.load_records(
+                    path, kind=perfdb.PLACEMENT_KIND
+                )
+                self._medians = {}
+                self._stamp = stamp
+            if bucket not in self._medians:
+                self._medians[bucket] = perfdb.substrate_medians(
+                    self._records, bucket
+                )
+            return self._medians[bucket]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stamp = None
+            self._records = []
+            self._medians = {}
+
+
+_PROFILES = _ProfileCache()
+
+
+def reset_profile_cache() -> None:
+    """Drop the cached placement-profile history (tests)."""
+    _PROFILES.reset()
+
+
+def record_outcome(substrate: str, n_reads: int, wall_s: float,
+                   phases: Optional[Dict[str, float]] = None,
+                   path: Optional[str] = None) -> str:
+    """Append one ``placement_profile`` perfdb record for a finished
+    job.  Call sites gate on :func:`learned_enabled` so the checked-in
+    history is never dirtied by default runs; returns the db path."""
+    extra: Dict = {
+        "substrate": substrate,
+        "n_reads": int(n_reads),
+        "reads_bucket": perfdb.reads_bucket(n_reads),
+    }
+    if phases:
+        extra["phases"] = {k: round(float(v), 6)
+                           for k, v in phases.items()}
+    record = perfdb.make_record(
+        perfdb.PLACEMENT_KIND, f"job_wall_s_{substrate}",
+        round(float(wall_s), 6), "s", **extra,
+    )
+    return perfdb.append_record(record, path)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,9 +174,21 @@ class PlacementPolicy:
             )
 
     def classify(self, request: JobRequest) -> str:
-        """``"mesh"`` or ``"arena"`` for one job."""
+        """``"mesh"`` or ``"arena"`` for one job.
+
+        With ``WAFFLE_PLACEMENT_LEARNED`` on, the job's pow2 reads
+        bucket is looked up in the perfdb placement profiles and the
+        substrate with the lower rolling median decision seconds wins;
+        cold history (either substrate under
+        :data:`MIN_PROFILE_SAMPLES` samples) falls back to the static
+        ``large_read_threshold``."""
+        n_reads = len(request.reads)
+        if learned_enabled():
+            learned = _PROFILES.decide(perfdb.reads_bucket(n_reads))
+            if learned is not None:
+                return learned
         return (
-            "mesh" if len(request.reads) >= self.large_read_threshold
+            "mesh" if n_reads >= self.large_read_threshold
             else "arena"
         )
 

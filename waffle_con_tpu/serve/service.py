@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence
 
 from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import phases as obs_phases
 from waffle_con_tpu.obs import slo as obs_slo
 from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.ops import ragged as ops_ragged
@@ -42,6 +43,7 @@ from waffle_con_tpu.analysis import lockcheck
 from waffle_con_tpu.utils import envspec
 from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
 from waffle_con_tpu.serve.dispatcher import BatchingDispatcher, CoalescingScorer
+from waffle_con_tpu.serve import placement as serve_placement
 from waffle_con_tpu.serve.job import (
     JobCancelled,
     JobHandle,
@@ -339,6 +341,11 @@ class ConsensusService:
         previous = set_scorer_decorator(
             lambda scorer: CoalescingScorer(scorer, dispatcher, ticket)
         )
+        profile = serve_placement.learned_enabled()
+        phases_before = obs_phases.totals() if (
+            profile and obs_phases.profiling_enabled()
+        ) else None
+        job_t0 = time.monotonic()
         try:
             with obs_trace.span(
                 "serve:job", "serve",
@@ -360,6 +367,10 @@ class ConsensusService:
                 report=getattr(engine, "last_search_report", None),
             )
             self._account(handle, "done")
+            if profile:
+                self._record_placement_outcome(
+                    handle, time.monotonic() - job_t0, phases_before
+                )
         finally:
             set_scorer_decorator(previous)
             # page-table residency ends with the job: whatever scorers
@@ -372,6 +383,35 @@ class ConsensusService:
                 pass
             self._dispatcher.job_finished()
             obs_trace.set_current_context(prev_ctx)
+
+    def _record_placement_outcome(self, handle: JobHandle, wall_s: float,
+                                  phases_before) -> None:
+        """Append one placement-profile perfdb record for a finished
+        job (``WAFFLE_PLACEMENT_LEARNED`` only — the flag gates both
+        the learning write and the learned read, so default runs never
+        dirty the checked-in history).  Substrate is what admission
+        actually chose: mesh iff ``_place`` rewrote ``mesh_shards``
+        into the job's config.  With phase profiling on, the process
+        phase-totals delta across the job rides along (concurrent jobs
+        blur it; the rolling medians absorb the noise)."""
+        config = handle.request.config
+        substrate = (
+            "mesh" if getattr(config, "mesh_shards", 0) >= 2 else "arena"
+        )
+        phases = None
+        if phases_before is not None:
+            after = obs_phases.totals()
+            phases = {
+                k: max(0.0, after.get(k, 0.0) - phases_before.get(k, 0.0))
+                for k in ("host_prep", "device_compute", "transfer")
+            }
+        try:
+            serve_placement.record_outcome(
+                substrate, len(handle.request.reads), wall_s,
+                phases=phases,
+            )
+        except Exception:  # pragma: no cover - profile IO never fails jobs
+            pass
 
     def _device_scope(self):
         """Context pinning this worker thread to the service's device

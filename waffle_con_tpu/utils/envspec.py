@@ -70,6 +70,9 @@ KNOBS: Dict[str, EnvKnob] = dict((
        "Arena per-member consensus capacity, clamped 256..65536"),
     _k("WAFFLE_RAGGED_GANG", "int", "8",
        "Max members per ragged kernel call, clamped 2..64"),
+    _k("WAFFLE_RAGGED_MIXED_W", "flag", "1 (on)",
+       "Width-agnostic arena pages: gang members of different band "
+       "widths (per-row W stride); `0` restores the W-equality gate"),
     # -- kernel selection (ops/) ---------------------------------------
     _k("WAFFLE_PALLAS", "enum", "auto",
        "Pallas kernel mode: `auto` (on iff TPU), `1` (interpret on "
@@ -88,6 +91,11 @@ KNOBS: Dict[str, EnvKnob] = dict((
     _k("WAFFLE_FRONTIER_SAMPLE", "int", "64",
        "Frontier sampler pop decimation (one record per N pops); `0` "
        "disables"),
+    # -- serve placement (serve/placement.py) --------------------------
+    _k("WAFFLE_PLACEMENT_LEARNED", "flag", "0 (off)",
+       "Learn mesh-vs-arena placement from perfdb substrate profiles "
+       "(rolling per-geometry medians); cold history falls back to the "
+       "static read-count threshold"),
     # -- runtime supervision -------------------------------------------
     _k("WAFFLE_WATCHDOG", "enum", "unset (warn)",
        "`strict` turns dispatch-budget overruns into WatchdogError"),
