@@ -1160,55 +1160,21 @@ def bench_serve_mix(num_jobs, error_rate=0.01):
     }
 
 
-def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
-    """Scale-out storm harness (``--storm N``): a heavy-tailed, bursty
-    job mix fired at the replicated front door.
+def _storm_mix(num_jobs, error_rate, supervised):
+    """The seeded storm workload shared by ``--storm`` (in-process
+    replicas) and ``--storm --procs`` (worker processes): the SAME
+    heavy-tailed job shapes, priority classes, configs, and
+    Poisson-burst arrival schedule, so the two harnesses measure
+    routing/transport differences, not workload luck.
 
-    The mix draws read counts and lengths from seeded Pareto tails (like
-    ``--serve-mix``), salts in mesh-large jobs that the placement policy
-    promotes onto the sharded scorer, and spreads priorities over three
-    classes.  Arrivals follow a Poisson burst process: exponentially
-    spaced bursts of geometrically distributed size, so admission sees
-    genuine queueing, not a smooth drip.
-
-    Two timed phases run the SAME mix on the SAME arrival schedule —
-    one replica, then ``replicas`` replicas — each preceded by an
-    untimed warmup pass that absorbs XLA compiles, and each timed
-    twice with the faster wall kept (noise-robust on shared CI
-    hosts; fault-armed phases time once).  Reports jobs/s for
-    both, the multi/single speedup, p50/p95/p99 job latency, a
-    per-replica occupancy/routing table, and a parity bit over every
-    completed job (both phases) against serial references.
-
-    ``supervised=True`` routes served jobs through the fault-tolerant
-    supervisor (serial references stay unsupervised), which is where
-    ``WAFFLE_FAULTS`` injection applies — the CI shedding demo demotes
-    one replica's backend mid-storm and the front door reroutes.  The
-    plan is armed for the TIMED multi-replica pass only (a bounded
-    firing count would otherwise be consumed by the warmups and the
-    single-replica baseline)."""
+    Returns ``(shapes, priorities, jobs, offsets, arrival_span,
+    large_threshold)`` where each ``jobs`` entry is ``(reads,
+    base_config, serve_config)`` — base is always unsupervised (serial
+    references), serve carries the supervisor knobs when asked."""
     import numpy as np
 
     from waffle_con_tpu import CdwfaConfigBuilder
-    from waffle_con_tpu.utils import envspec
-    from waffle_con_tpu.ops import ragged as ops_ragged
-    from waffle_con_tpu.ops.jax_scorer import compile_count
-    from waffle_con_tpu.serve import (
-        JobRequest,
-        PlacementPolicy,
-        ReplicatedConfig,
-        ReplicatedService,
-        ServeConfig,
-    )
-    from waffle_con_tpu.runtime import faults as runtime_faults
     from waffle_con_tpu.utils.example_gen import generate_test
-
-    fault_spec = ""
-    if supervised and envspec.get_raw("WAFFLE_FAULTS"):
-        # defuse the env plan now; re-armed just before the timed
-        # multi-replica pass (see docstring)
-        fault_spec = os.environ.pop("WAFFLE_FAULTS")
-        runtime_faults.install(None)
 
     rng = np.random.default_rng(20260805)
     large_threshold = 16
@@ -1248,13 +1214,6 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
              build_cfg(n_reads, seq_len, supervised))
         )
 
-    # serial references double as the base-compile warmup; the mesh
-    # variants compile during each phase's untimed warmup pass
-    serial = [
-        _make_engine("single", base_cfg, reads).consensus()
-        for reads, base_cfg, _serve_cfg in jobs
-    ]
-
     # Poisson bursts: exponential inter-burst gaps, geometric burst sizes
     offsets, t, i = [], 0.0, 0
     while i < num_jobs:
@@ -1264,6 +1223,64 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
             i += 1
         t += float(rng.exponential(0.004))
     arrival_span = offsets[-1] if offsets else 0.0
+    return shapes, priorities, jobs, offsets, arrival_span, large_threshold
+
+
+def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
+    """Scale-out storm harness (``--storm N``): a heavy-tailed, bursty
+    job mix fired at the replicated front door.
+
+    The mix draws read counts and lengths from seeded Pareto tails (like
+    ``--serve-mix``), salts in mesh-large jobs that the placement policy
+    promotes onto the sharded scorer, and spreads priorities over three
+    classes.  Arrivals follow a Poisson burst process: exponentially
+    spaced bursts of geometrically distributed size, so admission sees
+    genuine queueing, not a smooth drip.
+
+    Two timed phases run the SAME mix on the SAME arrival schedule —
+    one replica, then ``replicas`` replicas — each preceded by an
+    untimed warmup pass that absorbs XLA compiles, and each timed
+    twice with the faster wall kept (noise-robust on shared CI
+    hosts; fault-armed phases time once).  Reports jobs/s for
+    both, the multi/single speedup, p50/p95/p99 job latency, a
+    per-replica occupancy/routing table, and a parity bit over every
+    completed job (both phases) against serial references.
+
+    ``supervised=True`` routes served jobs through the fault-tolerant
+    supervisor (serial references stay unsupervised), which is where
+    ``WAFFLE_FAULTS`` injection applies — the CI shedding demo demotes
+    one replica's backend mid-storm and the front door reroutes.  The
+    plan is armed for the TIMED multi-replica pass only (a bounded
+    firing count would otherwise be consumed by the warmups and the
+    single-replica baseline)."""
+    from waffle_con_tpu.utils import envspec
+    from waffle_con_tpu.ops import ragged as ops_ragged
+    from waffle_con_tpu.ops.jax_scorer import compile_count
+    from waffle_con_tpu.serve import (
+        JobRequest,
+        PlacementPolicy,
+        ReplicatedConfig,
+        ReplicatedService,
+        ServeConfig,
+    )
+    from waffle_con_tpu.runtime import faults as runtime_faults
+
+    fault_spec = ""
+    if supervised and envspec.get_raw("WAFFLE_FAULTS"):
+        # defuse the env plan now; re-armed just before the timed
+        # multi-replica pass (see docstring)
+        fault_spec = os.environ.pop("WAFFLE_FAULTS")
+        runtime_faults.install(None)
+
+    (shapes, priorities, jobs, offsets, arrival_span,
+     large_threshold) = _storm_mix(num_jobs, error_rate, supervised)
+
+    # serial references double as the base-compile warmup; the mesh
+    # variants compile during each phase's untimed warmup pass
+    serial = [
+        _make_engine("single", base_cfg, reads).consensus()
+        for reads, base_cfg, _serve_cfg in jobs
+    ]
 
     policy = PlacementPolicy(large_read_threshold=large_threshold,
                              mesh_shards=2)
@@ -1387,6 +1404,155 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
         out["supervised"] = True
     if fault_spec:
         out["faults"] = fault_spec
+    return out
+
+
+def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
+                      kill_worker=False):
+    """Out-of-process storm (``--storm N --procs P``): the exact
+    workload and arrival schedule of :func:`bench_storm`, fired at the
+    :class:`~waffle_con_tpu.serve.procs.door.ProcFrontDoor` with real
+    worker processes instead of in-process replicas.
+
+    Two phases on the same mix: one worker process (baseline), then
+    ``procs`` workers.  A phase spawns its door ONCE and reuses it for
+    the untimed warmup pass (absorbs each worker's XLA compiles — the
+    fleet shares the persistent compile cache, so later workers mostly
+    load what the first compiled) plus two timed passes, keeping the
+    faster wall.  Every pass's results are parity-checked byte-for-byte
+    against in-process serial references.
+
+    ``kill_worker=True`` is the crash drill: during the (single) timed
+    multi-worker pass the busiest worker is SIGKILLed after a third of
+    the jobs have been submitted.  The front door must detect the dead
+    socket, requeue/restart the victim's jobs on the survivors, and
+    still finish with parity true and exactly one ``worker_lost``
+    flight incident — such runs measure degraded-mode behaviour and
+    never append a perfdb record."""
+    import signal
+
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.obs import slo as obs_slo
+    from waffle_con_tpu.serve import (
+        JobRequest,
+        PlacementPolicy,
+        ProcConfig,
+        ProcFrontDoor,
+    )
+
+    (shapes, priorities, jobs, offsets, arrival_span,
+     large_threshold) = _storm_mix(num_jobs, error_rate, False)
+
+    # in-process serial references (also warms the door-side jax import)
+    serial = [
+        _make_engine("single", base_cfg, reads).consensus()
+        for reads, base_cfg, _serve_cfg in jobs
+    ]
+
+    policy = PlacementPolicy(large_read_threshold=large_threshold,
+                             mesh_shards=2)
+
+    def run_phase(n_procs, kill=False):
+        door = ProcFrontDoor(ProcConfig(
+            workers=n_procs,
+            worker_slots=min(num_jobs, 4),
+            queue_limit=max(8, 2 * num_jobs),
+            batch_window_s=0.005,
+            max_batch=8,
+            placement=policy,
+            name="storm",
+        ))
+        timed_passes = 1 if kill else 2
+        best, parity_ok, killed = None, True, None
+        try:
+            for _attempt in range(1 + timed_passes):
+                reqs = [
+                    JobRequest(kind="single", reads=reads, config=cfg,
+                               priority=prio)
+                    for (reads, cfg, _scfg), prio in zip(jobs, priorities)
+                ]
+                t0 = time.perf_counter()
+                handles = []
+                for idx, (off, req) in enumerate(zip(offsets, reqs)):
+                    lag = off - (time.perf_counter() - t0)
+                    if lag > 0:
+                        time.sleep(lag)
+                    handles.append(door.submit(req))
+                    if (kill and _attempt == 1 and killed is None
+                            and n_procs > 1 and idx >= num_jobs // 3):
+                        victim = max(
+                            (w for w in door.worker_stats()
+                             if w["state"] == "up" and w["pid"]),
+                            key=lambda w: w["outstanding"],
+                        )
+                        os.kill(victim["pid"], signal.SIGKILL)
+                        killed = victim["worker"]
+                results = [h.result() for h in handles]
+                wall = time.perf_counter() - t0
+                lats = sorted(h.latency_s for h in handles)
+                parity_ok = parity_ok and all(
+                    r == ref for r, ref in zip(results, serial)
+                )
+                if _attempt == 0:
+                    continue
+                if best is None or wall < best[0]:
+                    best = (wall, lats)
+            stats = door.stats()
+            workers = door.worker_stats()
+        finally:
+            door.close()
+        return best + (stats, workers, parity_ok, killed)
+
+    s_wall, _s_lat, _s_stats, _s_workers, s_parity = run_phase(1)[:5]
+    m_wall, m_lat, m_stats, m_workers, m_parity, killed = run_phase(
+        procs, kill=kill_worker
+    )
+
+    parity = s_parity and m_parity
+    p50 = m_lat[len(m_lat) // 2]
+    p95 = m_lat[min(len(m_lat) - 1, int(len(m_lat) * 0.95))]
+    p99 = m_lat[min(len(m_lat) - 1, int(len(m_lat) * 0.99))]
+    lost_incidents = [
+        inc for inc in obs_flight.incidents()
+        if inc.get("reason") == "worker_lost"
+    ]
+
+    out = {
+        "metric": f"storm_procs_{num_jobs}jobs_{procs}p_jobs_per_s",
+        "value": round(num_jobs / m_wall, 4),
+        "unit": "jobs/s",
+        "mode": "storm-procs",
+        "jobs": num_jobs,
+        "procs": procs,
+        "shapes": shapes,
+        "priorities": priorities,
+        "mesh_placed": m_stats["jobs"].get("mesh_placed", 0),
+        "jobs_per_s": round(num_jobs / m_wall, 4),
+        "jobs_per_s_single": round(num_jobs / s_wall, 4),
+        "speedup_vs_single": round(s_wall / m_wall, 4),
+        "wall_s": round(m_wall, 4),
+        "arrival_span_s": round(arrival_span, 4),
+        "p50_job_latency_s": round(p50, 4),
+        "p95_job_latency_s": round(p95, 4),
+        "p99_job_latency_s": round(p99, 4),
+        "parity": parity,
+        "aged_pops": m_stats.get("aged_pops", 0),
+        "per_worker": m_workers,
+        "workers_participating": sum(
+            1 for w in m_workers if w["routed"] > 0
+        ),
+        "requeues": sum(w["requeues"] for w in m_workers),
+        "worker_lost_incidents": len(lost_incidents),
+        "slo": obs_slo.snapshot(),
+        "incidents": [
+            {k: inc.get(k) for k in
+             ("seq", "reason", "trace_id", "unix_time", "path")}
+            for inc in obs_flight.incidents()
+        ],
+        "runtime_events": _runtime_events(),
+    }
+    if kill_worker:
+        out["kill_worker"] = killed or True
     return out
 
 
@@ -1824,6 +1990,20 @@ def main() -> None:
         help="with --storm: replica count for the multi-replica phase",
     )
     parser.add_argument(
+        "--procs", type=int, default=None, metavar="P",
+        help="with --storm: drive the storm through the out-of-process "
+        "front door with P real worker processes (instead of in-process "
+        "replicas); reports jobs/s vs a single-worker-process baseline "
+        "on the same schedule, a per-worker table, and the parity bit",
+    )
+    parser.add_argument(
+        "--kill-worker", action="store_true", dest="kill_worker",
+        help="with --storm --procs: crash drill — SIGKILL the busiest "
+        "worker mid-storm; the run must still finish with parity true "
+        "(jobs requeued/restarted on the survivors) and records the "
+        "worker_lost incident; never appends a perfdb record",
+    )
+    parser.add_argument(
         "--serve-supervised", action="store_true",
         help="with --serve: run the served jobs under the fault-"
         "tolerant supervisor (warmup stays unsupervised), so "
@@ -1982,6 +2162,18 @@ def main() -> None:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
 
         enable_compilation_cache()
+        if args.procs:
+            out = bench_storm_procs(
+                args.storm,
+                procs=args.procs,
+                kill_worker=args.kill_worker,
+            )
+            out["device_platform"] = _current_platform()
+            # crash drills measure degraded-mode behaviour — never let
+            # them into the rolling perf baseline
+            _emit(out, perfdb_kind=None if out.get("kill_worker")
+                  else "storm-procs")
+            return
         out = bench_storm(
             args.storm,
             replicas=args.replicas,

@@ -7,6 +7,10 @@ compact top-style dashboard: job counts and queue depth, dispatcher
 batching occupancy, rolling SLO percentiles (p50/p95/p99 + EWMA over
 dispatch latency and job wall time), per-backend dispatch latency from
 the metrics snapshot, and the most recent flight-recorder incidents.
+When the payload comes from a :class:`ProcFrontDoor` (out-of-process
+serving) the per-worker table shows pid, health state, outstanding
+jobs, slot occupancy, and requeue/demote/shed counters instead of the
+in-process replica table.
 
 Usage::
 
@@ -116,6 +120,29 @@ def render(payload: dict, plain: bool = False) -> str:
                 f"{rep.get('sheds', 0):>4} "
                 f"{rep.get('mean_batch_occupancy', 0):>5.2f} "
                 f"{(str(hold) + 'ms') if hold is not None else '-':>8}"
+            )
+
+    workers = payload.get("workers") or stats.get("workers")
+    if workers:
+        lines.append(f"{bold}worker processes{reset} ({len(workers)})")
+        lines.append(
+            f"  {'worker':<16} {'pid':>7} {'state':<9} {'outst':>5} "
+            f"{'slots':>5} {'occ':>5} {'routed':>6} {'requeue':>7} "
+            f"{'demote':>6} {'shed':>4} {'readmit':>7}"
+        )
+        for wkr in workers:
+            lines.append(
+                f"  {str(wkr.get('worker', '?'))[:16]:<16} "
+                f"{wkr.get('pid') or '-':>7} "
+                f"{str(wkr.get('state', '?')):<9} "
+                f"{wkr.get('outstanding', 0):>5} "
+                f"{wkr.get('slots', 0):>5} "
+                f"{wkr.get('occupancy', 0):>5.2f} "
+                f"{wkr.get('routed', 0):>6} "
+                f"{wkr.get('requeues', 0):>7} "
+                f"{wkr.get('demotions', 0):>6} "
+                f"{wkr.get('sheds', 0):>4} "
+                f"{wkr.get('readmits', 0):>7}"
             )
 
     slo = payload.get("slo", {})

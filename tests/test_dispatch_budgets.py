@@ -1,0 +1,126 @@
+"""Fast-path engagement regression net: per scenario family, the
+blocking-dispatch profile is pinned.
+
+Speculative K-column stepping, the dual kernel, and the ragged arena
+all exist to keep the Python↔device round-trip count flat; a refactor
+that silently disengages one of them shows up here as a budget bust
+(every dispatch family counter is in
+``ops.scorer.DISPATCH_COUNTER_KEYS``) long before it shows up as a
+wall-clock regression on a noisy host.  Budgets are the counts
+measured at WAFFLE_RUN_COLS=1 on the jax CPU backend with ~40%
+headroom — they gate "an extra dispatch per step" regressions, not
+single-call jitter.  ``run_pallas_calls`` must stay exactly zero on
+CPU: the interpret-mode Pallas path engaging off-TPU is itself a bug.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    ConsensusDWFA,
+    DualConsensusDWFA,
+    PriorityConsensusDWFA,
+)
+from waffle_con_tpu.config import CdwfaConfigBuilder
+from waffle_con_tpu.ops.scorer import DISPATCH_COUNTER_KEYS
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+pytestmark = pytest.mark.serve
+
+
+def _cfg(**kw):
+    builder = (CdwfaConfigBuilder().backend("jax").min_count(2)
+               .initial_band(16))
+    for key, value in kw.items():
+        builder = getattr(builder, key)(value)
+    return builder.build()
+
+
+def _dual_reads(seq_len, per_hap, split_at, seed=11):
+    truth, reads1 = generate_test(4, seq_len, per_hap, 0.01, seed=seed)
+    hap2 = bytearray(truth)
+    for pos in split_at:
+        hap2[pos] = (hap2[pos] + 1) % 4
+    hap2 = bytes(hap2)
+    reads2 = [corrupt(hap2, 0.01, np.random.default_rng(700 + i))
+              for i in range(per_hap)]
+    return list(reads1) + reads2
+
+
+def _single_clean():
+    _, reads = generate_test(4, 120, 6, 0.01, seed=5)
+    engine = ConsensusDWFA(_cfg())
+    for read in reads:
+        engine.add_sequence(read)
+    return engine
+
+
+def _dual_split():
+    engine = DualConsensusDWFA(_cfg())
+    for read in _dual_reads(80, 4, (30, 60)):
+        engine.add_sequence(read)
+    return engine
+
+
+def _locked_tail():
+    # haplotypes diverge only near the end: both branches lock a long
+    # shared prefix before the dual split engages
+    engine = DualConsensusDWFA(_cfg())
+    for read in _dual_reads(150, 4, (140, 145)):
+        engine.add_sequence(read)
+    return engine
+
+
+def _min_af():
+    engine = DualConsensusDWFA(_cfg(min_af=0.25))
+    for read in _dual_reads(80, 4, (30, 60), seed=13):
+        engine.add_sequence(read)
+    return engine
+
+
+def _priority_chain():
+    _, level0 = generate_test(4, 60, 4, 0.01, seed=3)
+    t1a, _ = generate_test(4, 80, 1, 0.0, seed=4)
+    t1b = bytearray(t1a)
+    t1b[30] = (t1b[30] + 1) % 4
+    t1b[60] = (t1b[60] + 2) % 4
+    t1b = bytes(t1b)
+    engine = PriorityConsensusDWFA(_cfg())
+    for i in range(4):
+        level1 = corrupt(t1a if i < 2 else t1b, 0.01,
+                         np.random.default_rng(200 + i))
+        engine.add_sequence_chain([level0[i], level1])
+    return engine
+
+
+# (build, total-dispatch budget) — measured totals: 1/14/6/14/63
+_FAMILIES = {
+    "single_clean": (_single_clean, 2),
+    "dual_split": (_dual_split, 19),
+    "locked_tail": (_locked_tail, 9),
+    "min_af": (_min_af, 19),
+    "priority_chain": (_priority_chain, 85),
+}
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+def test_blocking_dispatch_budget(family):
+    build, budget = _FAMILIES[family]
+    engine = build()
+    assert engine.consensus()  # the scenario must actually resolve
+    counters = engine.last_search_stats["scorer_counters"]
+    total = sum(counters.get(key, 0) for key in DISPATCH_COUNTER_KEYS)
+    assert 0 < total <= budget, (
+        f"{family}: {total} blocking dispatches > budget {budget} "
+        f"({ {k: v for k, v in sorted(counters.items()) if v} })"
+    )
+    # the batched device loop must be engaged, not degenerated into
+    # per-step host round-trips
+    steps = (counters.get("run_steps", 0)
+             + counters.get("run_dual_steps", 0)
+             + counters.get("arena_steps", 0))
+    assert steps > total, (family, steps, total)
+    # interpret-mode Pallas must never engage on the CPU backend
+    pallas = (counters.get("run_pallas_calls", 0)
+              + counters.get("run_dual_pallas_calls", 0))
+    assert pallas == 0, (family, pallas)

@@ -1,0 +1,566 @@
+"""Out-of-process serving: wire codec hardening, front-door routing /
+health / crash-requeue semantics (fake in-thread workers speaking the
+real protocol), and real worker-process parity + SIGKILL drills.
+
+The fake-worker tests exercise every door-side path without spawning
+an interpreter per worker: ``ProcConfig.launcher`` is the seam — a
+thread connects to the door's socket and speaks byte-identical frames,
+fabricating results instead of running engines.  The two subprocess
+tests (marked ``slow``; the CI smoke drives the same paths through
+``bench.py --storm --procs``) prove the real
+``python -m waffle_con_tpu.serve.procs.worker`` stack end to end.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.models.consensus import Consensus
+from waffle_con_tpu.models.dual_consensus import DualConsensus
+from waffle_con_tpu.models.priority_consensus import PriorityConsensus
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.runtime.liveness import Heartbeats, WorkerLost
+from waffle_con_tpu.serve import (
+    JobRequest,
+    JobStatus,
+    ProcConfig,
+    ProcFrontDoor,
+    ServiceOverloaded,
+)
+from waffle_con_tpu.serve.procs import wire
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------
+
+def test_frame_roundtrip_every_type():
+    decoder = wire.FrameDecoder()
+    payloads = {ftype: {"n": int(ftype), "name": ftype.name}
+                for ftype in wire.FrameType}
+    blob = b"".join(
+        wire.encode_frame(ftype, obj) for ftype, obj in payloads.items()
+    )
+    frames = decoder.feed(blob)
+    assert [(f, o) for f, o in frames] == list(payloads.items())
+    assert decoder.pending() == 0
+
+
+def test_torn_frames_buffer_without_hanging():
+    # one byte at a time: nothing decodes until the frame completes,
+    # and the decoder never blocks or raises on partial input
+    frame = wire.encode_frame(wire.FrameType.PING, {"x": 1})
+    decoder = wire.FrameDecoder()
+    for byte in frame[:-1]:
+        assert decoder.feed(bytes([byte])) == []
+    assert decoder.feed(frame[-1:]) == [(wire.FrameType.PING, {"x": 1})]
+
+
+def test_two_frames_in_one_chunk_plus_tail():
+    a = wire.encode_frame(wire.FrameType.PING, {})
+    b = wire.encode_frame(wire.FrameType.PONG, {"outstanding": 2})
+    c = wire.encode_frame(wire.FrameType.DRAIN, {})
+    decoder = wire.FrameDecoder()
+    got = decoder.feed(a + b + c[:4])
+    assert [f for f, _ in got] == [wire.FrameType.PING, wire.FrameType.PONG]
+    assert decoder.feed(c[4:]) == [(wire.FrameType.DRAIN, {})]
+
+
+def test_bad_checksum_is_typed():
+    frame = bytearray(wire.encode_frame(wire.FrameType.RESULT, {"job": 1}))
+    frame[-1] ^= 0xFF  # flip a payload byte; header CRC now mismatches
+    with pytest.raises(wire.BadChecksum):
+        wire.FrameDecoder().feed(bytes(frame))
+
+
+def test_future_version_is_typed():
+    frame = bytearray(wire.encode_frame(wire.FrameType.PING, {}))
+    frame[0] = wire.FRAME_VERSION + 1
+    with pytest.raises(wire.UnsupportedVersion):
+        wire.FrameDecoder().feed(bytes(frame))
+
+
+def test_unknown_frame_type_is_typed():
+    payload = b"{}"
+    import zlib
+
+    frame = wire.HEADER.pack(
+        wire.FRAME_VERSION, 200, len(payload), zlib.crc32(payload)
+    ) + payload
+    with pytest.raises(wire.UnknownFrameType):
+        wire.FrameDecoder().feed(frame)
+
+
+def test_oversized_declared_length_is_typed(monkeypatch):
+    monkeypatch.setenv("WAFFLE_PROC_FRAME_MAX", "4096")
+    header = wire.HEADER.pack(wire.FRAME_VERSION, 1, 1 << 20, 0)
+    with pytest.raises(wire.FrameTooLarge):
+        wire.FrameDecoder().feed(header)
+    with pytest.raises(wire.FrameTooLarge):
+        wire.encode_frame(wire.FrameType.SUBMIT, {"x": "a" * 8192})
+
+
+def test_garbage_payload_is_typed_never_a_hang():
+    # correct header + CRC over non-JSON bytes: typed WireError
+    import zlib
+
+    payload = b"\xff\xfe not json"
+    frame = wire.HEADER.pack(
+        wire.FRAME_VERSION, int(wire.FrameType.PING), len(payload),
+        zlib.crc32(payload),
+    ) + payload
+    with pytest.raises(wire.WireError):
+        wire.FrameDecoder().feed(frame)
+
+
+def test_header_fuzz_never_untyped(monkeypatch):
+    # every mutation of a valid frame must raise a WireError subclass
+    # or decode cleanly — nothing untyped, nothing hangs
+    monkeypatch.setenv("WAFFLE_PROC_FRAME_MAX", "65536")
+    base = wire.encode_frame(wire.FrameType.HEALTH, {"reason": "x"})
+    import random
+
+    rng = random.Random(20260806)
+    for _ in range(300):
+        blob = bytearray(base)
+        for _ in range(rng.randint(1, 4)):
+            blob[rng.randrange(len(blob))] = rng.randrange(256)
+        decoder = wire.FrameDecoder()
+        try:
+            decoder.feed(bytes(blob))
+        except wire.WireError:
+            pass
+
+
+def test_config_codec_roundtrip():
+    cfg = CdwfaConfig(
+        consensus_cost=ConsensusCost.L2_DISTANCE, max_queue_size=7,
+        min_af=0.25, wildcard=ord("N"), backend="jax", mesh_shards=2,
+        initial_band=32, backend_chain=("jax", "python"),
+        supervised=True, dual_max_ed_delta=9,
+    )
+    assert wire.decode_config(wire.encode_config(cfg)) == cfg
+    assert wire.decode_config(None) is None
+    # unknown fields from a newer peer are dropped, not fatal
+    obj = wire.encode_config(cfg)
+    obj["knob_from_the_future"] = 42
+    assert wire.decode_config(obj) == cfg
+
+
+def test_request_codec_roundtrip_all_kinds():
+    single = JobRequest(kind="single", reads=(b"ACGT", b"ACG"),
+                        offsets=(None, 1), priority=2, deadline_s=9.0,
+                        tag="t", config=CdwfaConfig())
+    rt = wire.decode_request(wire.encode_request(single))
+    assert (rt.kind, rt.reads, rt.offsets, rt.priority, rt.tag) == \
+        (single.kind, single.reads, single.offsets, single.priority,
+         single.tag)
+    assert rt.config == single.config
+    chain = JobRequest(kind="priority",
+                       reads=((b"AC", b"ACGT"), (b"AG", b"ACGA")))
+    assert wire.decode_request(wire.encode_request(chain)).reads == \
+        chain.reads
+    # the door rewrites the deadline to the REMAINING budget
+    sent = wire.encode_request(single, deadline_left_s=1.5)
+    assert sent["deadline_s"] == 1.5
+
+
+def test_result_codec_roundtrip_all_kinds():
+    c1 = Consensus(b"ACGT", ConsensusCost.L1_DISTANCE, [0, 1])
+    c2 = Consensus(b"ACGA", ConsensusCost.L1_DISTANCE, [2, 0])
+    single = [c1, c2]
+    assert wire.decode_result(
+        "single", wire.encode_result("single", single)
+    ) == single
+    dual = [DualConsensus(c1, c2, [True, False], [0, None], [None, 0]),
+            DualConsensus(c1, None, [True, True], [0, 1], [None, None])]
+    assert wire.decode_result(
+        "dual", wire.encode_result("dual", dual)
+    ) == dual
+    prio = PriorityConsensus([[c1], [c1, c2]], [0, 1])
+    assert wire.decode_result(
+        "priority", wire.encode_result("priority", prio)
+    ) == prio
+    with pytest.raises(wire.WireError):
+        wire.encode_result("nope", [])
+    with pytest.raises(wire.WireError):
+        wire.decode_result("single", [{"bad": 1}])
+
+
+# ---------------------------------------------------------------------
+# fake in-thread workers: full protocol, scripted behaviour
+# ---------------------------------------------------------------------
+
+class FakeWorker:
+    """A worker that is really a thread: connects to the door's
+    socket, HELLOs, and answers SUBMITs with fabricated results.
+
+    ``behavior`` per worker name:
+      * ``"ok"`` — STARTED then RESULT for every job;
+      * ``"crash-after-start"`` — STARTED for the first job, then the
+        socket slams shut (simulates SIGKILL mid-job);
+      * ``"silent"`` — HELLO then never answers anything (liveness
+        lapse path);
+      * ``"hold"`` — accepts jobs, never finishes them (drain tests);
+      * ``"demote_hold"`` — first job: forward a backend_demoted
+        HEALTH trigger, STARTED, then hold the result until
+        ``release`` is set (drain-then-readmit tests).
+    """
+
+    def __init__(self, socket_path, name, spec, behavior="ok",
+                 triggers=None):
+        self.name = name
+        self.behavior = behavior
+        self.triggers = list(triggers or [])
+        self.jobs_seen = []
+        self.release = threading.Event()
+        self.pid = os.getpid()
+        self._exited = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(socket_path,), daemon=True
+        )
+        self._thread.start()
+
+    # Popen-like surface the door's watchdog expects
+    def poll(self):
+        return None if not self._exited.is_set() else 0
+
+    def wait(self, timeout=None):
+        self._exited.wait(timeout)
+        return 0
+
+    def terminate(self):
+        self._exited.set()
+
+    kill = terminate
+
+    def _reply(self, sock, job_id, request):
+        result = [Consensus(
+            b"FAKE", ConsensusCost.L1_DISTANCE, [0] * len(request.reads)
+        )]
+        sock.sendall(wire.encode_frame(
+            wire.FrameType.STARTED, {"job": job_id}
+        ))
+        sock.sendall(wire.encode_frame(wire.FrameType.RESULT, {
+            "job": job_id, "kind": "single",
+            "result": wire.encode_result("single", result),
+        }))
+
+    def _run(self, socket_path):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(socket_path)
+        decoder = wire.FrameDecoder()
+        sock.sendall(wire.encode_frame(wire.FrameType.HELLO, {
+            "worker": self.name, "pid": self.pid, "slots": 2,
+        }))
+        for trig in self.triggers:
+            sock.sendall(wire.encode_frame(wire.FrameType.HEALTH, trig))
+        try:
+            while True:
+                data = sock.recv(65536)
+                if not data:
+                    return
+                if self.behavior == "silent":
+                    continue
+                for ftype, obj in decoder.feed(data):
+                    if ftype is wire.FrameType.PING:
+                        sock.sendall(wire.encode_frame(
+                            wire.FrameType.PONG,
+                            {"outstanding": 0, "slots": 2},
+                        ))
+                    elif ftype is wire.FrameType.SUBMIT:
+                        job_id = obj["job"]
+                        request = wire.decode_request(obj["request"])
+                        self.jobs_seen.append(job_id)
+                        if self.behavior == "hold":
+                            continue
+                        if self.behavior == "crash-after-start":
+                            sock.sendall(wire.encode_frame(
+                                wire.FrameType.STARTED, {"job": job_id}
+                            ))
+                            return  # slam the socket mid-job
+                        if (self.behavior == "demote_hold"
+                                and len(self.jobs_seen) == 1):
+                            sock.sendall(wire.encode_frame(
+                                wire.FrameType.HEALTH,
+                                {"worker": self.name,
+                                 "reason": "backend_demoted",
+                                 "trace": f"{self.name}/job-{job_id}",
+                                 "detail": {}},
+                            ))
+                            sock.sendall(wire.encode_frame(
+                                wire.FrameType.STARTED, {"job": job_id}
+                            ))
+
+                            def _later(jid=job_id, req=request):
+                                self.release.wait(10)
+                                try:
+                                    sock.sendall(wire.encode_frame(
+                                        wire.FrameType.RESULT, {
+                                            "job": jid, "kind": "single",
+                                            "result": wire.encode_result(
+                                                "single",
+                                                [Consensus(
+                                                    b"FAKE",
+                                                    ConsensusCost.L1_DISTANCE,
+                                                    [0] * len(req.reads),
+                                                )],
+                                            ),
+                                        }
+                                    ))
+                                except OSError:
+                                    pass
+
+                            threading.Thread(
+                                target=_later, daemon=True
+                            ).start()
+                            continue
+                        self._reply(sock, job_id, obj and request)
+                    elif ftype is wire.FrameType.SHUTDOWN:
+                        return
+        except OSError:
+            pass
+        finally:
+            self._exited.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class FakeFleet:
+    """Launcher seam: hands the door FakeWorkers by scripted name."""
+
+    def __init__(self, behaviors=None, triggers=None):
+        self.behaviors = behaviors or {}
+        self.triggers = triggers or {}
+        self.workers = {}
+
+    def __call__(self, socket_path, name, spec):
+        worker = FakeWorker(
+            socket_path, name, spec,
+            behavior=self.behaviors.get(name, "ok"),
+            triggers=self.triggers.get(name),
+        )
+        self.workers[name] = worker
+        return worker
+
+
+def _request(n_reads=2):
+    return JobRequest(kind="single", reads=(b"ACGT",) * n_reads,
+                      config=CdwfaConfig())
+
+
+def _door(fleet, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("name", "fake")
+    kw.setdefault("spawn_timeout_s", 10.0)
+    return ProcFrontDoor(ProcConfig(launcher=fleet, **kw))
+
+
+def test_fake_fleet_routes_and_decodes():
+    fleet = FakeFleet()
+    with _door(fleet) as door:
+        handles = [door.submit(_request()) for _ in range(6)]
+        results = [h.result(timeout=10) for h in handles]
+    assert all(r[0].sequence == b"FAKE" for r in results)
+    stats = door.worker_stats()
+    assert sum(w["routed"] for w in stats) == 6
+    assert all(w["routed"] > 0 for w in stats)  # both participated
+
+
+def test_health_demotion_drains_then_readmits():
+    # the routing tie-break is worker index: the first job lands on w0
+    fleet = FakeFleet(behaviors={"fake:w0": "demote_hold"})
+    with _door(fleet) as door:
+        first = door.submit(_request())
+        # the first job's worker demotes itself and holds the result:
+        # it must show DRAINING while the job is still outstanding
+        deadline = time.monotonic() + 5
+        demoted = None
+        while time.monotonic() < deadline and demoted is None:
+            demoted = next(
+                (w for w in door.worker_stats()
+                 if w["state"] == "draining"), None,
+            ) or time.sleep(0.01)
+        assert demoted, door.worker_stats()
+        healthy = next(w["worker"] for w in door.worker_stats()
+                       if w["worker"] != demoted["worker"])
+        # while draining with a healthy peer, nothing new routes to it
+        for _ in range(4):
+            door.submit(_request()).result(timeout=10)
+        stats = {w["worker"]: w for w in door.worker_stats()}
+        assert stats[healthy]["routed"] == 4
+        assert stats[demoted["worker"]]["routed"] == 1
+        assert stats[demoted["worker"]]["demotions"] == 1
+        # release the held job: drained (zero outstanding) means the
+        # next routing decision re-admits it
+        fleet.workers[demoted["worker"]].release.set()
+        assert first.result(timeout=10)[0].sequence == b"FAKE"
+        door.submit(_request()).result(timeout=10)
+        stats = {w["worker"]: w for w in door.worker_stats()}
+        assert stats[demoted["worker"]]["state"] == "up"
+        assert stats[demoted["worker"]]["readmits"] == 1
+
+
+def test_health_slow_search_sheds_with_cooldown():
+    fleet = FakeFleet(triggers={
+        "fake:w1": [{"worker": "fake:w1", "reason": "slow_search",
+                     "trace": "fake:w1/job-9", "detail": {}}],
+    })
+    with _door(fleet, shed_cooldown_s=0.2) as door:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            states = {w["worker"]: w["state"]
+                      for w in door.worker_stats()}
+            if states["fake:w1"] == "shedding":
+                break
+            time.sleep(0.01)
+        assert states["fake:w1"] == "shedding"
+        time.sleep(0.25)  # cooldown expires at the next routing pass
+        door.submit(_request()).result(timeout=10)
+        states = {w["worker"]: w["state"] for w in door.worker_stats()}
+        assert states["fake:w1"] == "up"
+        sheds = {w["worker"]: w["sheds"] for w in door.worker_stats()}
+        assert sheds["fake:w1"] == 1
+
+
+def test_crashed_worker_requeues_and_single_incident():
+    obs_flight.reset()
+    fleet = FakeFleet(behaviors={"fake:w0": "crash-after-start"})
+    with _door(fleet, worker_slots=1, inflight=1) as door:
+        handles = [door.submit(_request()) for _ in range(4)]
+        results = [h.result(timeout=10) for h in handles]
+        assert all(r[0].sequence == b"FAKE" for r in results)
+        stats = {w["worker"]: w for w in door.worker_stats()}
+        assert stats["fake:w0"]["state"] == "lost"
+        # the started job restarted + any queued job requeued
+        assert stats["fake:w0"]["requeues"] >= 1
+        assert stats["fake:w1"]["routed"] == 4
+    incidents = [i for i in obs_flight.incidents()
+                 if i["reason"] == "worker_lost"]
+    assert len(incidents) == 1  # exactly one, despite reader+watchdog
+
+
+def test_restart_lost_off_fails_started_jobs_typed():
+    obs_flight.reset()
+    fleet = FakeFleet(behaviors={"fake:w0": "crash-after-start",
+                                 "fake:w1": "crash-after-start"})
+    with _door(fleet, restart_lost=False, worker_slots=1,
+               inflight=1) as door:
+        handle = door.submit(_request())
+        assert handle.wait(10)
+        assert handle.status is JobStatus.FAILED
+        with pytest.raises(WorkerLost):
+            handle.result(timeout=0)
+
+
+def test_silent_worker_hits_liveness_lapse(monkeypatch):
+    monkeypatch.setenv("WAFFLE_PROC_PING_S", "0.05")
+    monkeypatch.setenv("WAFFLE_PROC_LIVENESS_S", "0.3")
+    obs_flight.reset()
+    fleet = FakeFleet(behaviors={"fake:w0": "silent"})
+    with _door(fleet, worker_slots=1, inflight=1) as door:
+        handles = [door.submit(_request()) for _ in range(3)]
+        results = [h.result(timeout=10) for h in handles]
+        assert all(r[0].sequence == b"FAKE" for r in results)
+        states = {w["worker"]: w["state"] for w in door.worker_stats()}
+        assert states["fake:w0"] == "lost"
+
+
+def test_admission_rejects_when_full():
+    fleet = FakeFleet(behaviors={"fake:w0": "hold"})
+    door = _door(fleet, workers=1, queue_limit=2, worker_slots=1,
+                 inflight=1)
+    try:
+        # the held worker absorbs the routing window; the bounded
+        # queue behind it fills and the door rejects, never blocks
+        with pytest.raises(ServiceOverloaded):
+            for _ in range(12):
+                door.submit(_request())
+    finally:
+        door.close(cancel_pending=True, timeout=2.0)
+
+
+def test_heartbeats_ledger():
+    clock = [0.0]
+    beats = Heartbeats(clock=lambda: clock[0])
+    beats.beat("a")
+    clock[0] = 1.0
+    beats.beat("b")
+    clock[0] = 3.0
+    assert beats.age("a") == 3.0
+    assert beats.lapsed(2.5) == ["a"]
+    assert sorted(beats.lapsed(0.5)) == ["a", "b"]
+    beats.forget("a")
+    assert beats.age("a") is None
+
+
+# ---------------------------------------------------------------------
+# real worker processes (slow: ~seconds of interpreter+jax spawn each;
+# the CI smoke exercises the same stack via bench.py --storm --procs)
+# ---------------------------------------------------------------------
+
+def _python_cfg(**kw):
+    return CdwfaConfig(backend="python", min_count=2, **kw)
+
+
+def test_subprocess_worker_end_to_end_parity():
+    from waffle_con_tpu.serve.service import _build_engine
+
+    reqs = [
+        JobRequest(kind="single", reads=(b"ACGTACGTAC",) * 3,
+                   config=_python_cfg()),
+        JobRequest(kind="dual",
+                   reads=(b"ACGTACGTAC", b"ACGTACGTAC",
+                          b"ACTTACGTAC", b"ACTTACGTAC"),
+                   config=_python_cfg()),
+        JobRequest(kind="priority",
+                   reads=((b"ACGT", b"ACGTACGT"),
+                          (b"ACGA", b"ACGTACGA"),
+                          (b"ACGT", b"ACGTACGT")),
+                   config=_python_cfg()),
+    ]
+    refs = [_build_engine(r).consensus() for r in reqs]
+    with ProcFrontDoor(ProcConfig(workers=1, name="e2e")) as door:
+        handles = [door.submit(r) for r in reqs for _ in range(2)]
+        results = [h.result(timeout=60) for h in handles]
+    for i, ref in enumerate(refs):
+        assert results[2 * i] == ref
+        assert results[2 * i + 1] == ref
+
+
+@pytest.mark.slow
+def test_subprocess_sigkill_drill(monkeypatch):
+    monkeypatch.setenv("WAFFLE_PROC_PING_S", "0.2")
+    monkeypatch.setenv("WAFFLE_PROC_LIVENESS_S", "2.0")
+    obs_flight.reset()
+    from waffle_con_tpu.serve.service import _build_engine
+
+    req = JobRequest(
+        kind="dual", reads=(b"ACGTACGTACGTACGTACGT" * 3,) * 5,
+        config=_python_cfg(),
+    )
+    ref = _build_engine(req).consensus()
+    with ProcFrontDoor(ProcConfig(
+        workers=2, worker_slots=1, name="drill",
+    )) as door:
+        handles = [door.submit(req) for _ in range(8)]
+        time.sleep(0.3)
+        victim = next(w for w in door.worker_stats() if w["pid"])
+        os.kill(victim["pid"], signal.SIGKILL)
+        results = [h.result(timeout=120) for h in handles]
+    assert all(r == ref for r in results)  # parity survives the crash
+    stats = {w["worker"]: w for w in door.worker_stats()}
+    assert stats[victim["worker"]]["state"] == "lost"
+    assert sum(w["requeues"] for w in stats.values()) >= 1
+    incidents = [i for i in obs_flight.incidents()
+                 if i["reason"] == "worker_lost"]
+    assert len(incidents) == 1

@@ -166,3 +166,30 @@ def test_render_replica_table(waffle_top):
     assert "consensus:r0" in out and "consensus:r1" in out
     assert "draining" in out
     assert "1.2ms" in out
+
+
+def test_render_worker_process_table(waffle_top):
+    payload = _payload()
+    payload["service"] = "storm"
+    payload["workers"] = [
+        {
+            "worker": "storm:w0", "pid": 4242, "state": "up",
+            "outstanding": 2, "slots": 2, "occupancy": 1.0,
+            "routed": 9, "requeues": 0, "demotions": 0, "sheds": 0,
+            "readmits": 0,
+        },
+        {
+            "worker": "storm:w1", "pid": None, "state": "lost",
+            "outstanding": 0, "slots": 2, "occupancy": 0.0,
+            "routed": 4, "requeues": 3, "demotions": 1, "sheds": 0,
+            "readmits": 0,
+        },
+    ]
+    out = waffle_top.render(payload, plain=True)
+    assert "worker processes (2)" in out
+    assert "storm:w0" in out and "4242" in out
+    assert "storm:w1" in out and "lost" in out
+    # a dead worker renders a placeholder pid, not a crash
+    lost_row = next(l for l in out.splitlines() if "storm:w1" in l)
+    assert " - " in lost_row
+    assert "1.00" in out  # occupancy column

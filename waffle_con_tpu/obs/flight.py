@@ -11,8 +11,11 @@ record) — and only does real work when an **anomaly trigger** fires.
 
 Triggers (see :data:`TRIGGER_REASONS`): ``deadline_exceeded``,
 ``backend_demoted``, ``cache_quarantine``, ``service_overloaded``,
-``watchdog_budget_exceeded``, and the SLO layer's ``slow_search``
-(current search > k× rolling p95, :mod:`waffle_con_tpu.obs.slo`).
+``watchdog_budget_exceeded``, the SLO layer's ``slow_search``
+(current search > k× rolling p95, :mod:`waffle_con_tpu.obs.slo`), and
+the out-of-process front door's ``worker_lost`` (a worker process
+crashed or went silent past the liveness lapse,
+:mod:`waffle_con_tpu.serve.procs.door`).
 
 On a trigger the recorder assembles a self-contained JSON **incident**:
 the triggering job's records (filtered from the ring by trace id),
@@ -59,6 +62,7 @@ TRIGGER_REASONS = (
     "service_overloaded",
     "watchdog_budget_exceeded",
     "slow_search",
+    "worker_lost",
 )
 
 DEFAULT_RING_SIZE = 2048

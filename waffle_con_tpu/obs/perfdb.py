@@ -84,6 +84,12 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "speedup_vs_single", "p95_job_latency_s", "p99_job_latency_s",
         "replicas", "per_replica", "mesh_placed", "shed",
     ),
+    "storm-procs": (
+        "parity", "procs", "jobs_per_s", "jobs_per_s_single",
+        "speedup_vs_single", "p95_job_latency_s", "p99_job_latency_s",
+        "per_worker", "workers_participating", "requeues",
+        "worker_lost_incidents", "mesh_placed",
+    ),
     "microbench": ("parity", "steps", "stop_code", "breakdown"),
     "north-star": ("parity", "vs_baseline", "breakdown"),
 }

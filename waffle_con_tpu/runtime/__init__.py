@@ -21,6 +21,9 @@ instead of crashes:
   accounting over the scorer counters, turning silent fast-path
   engagement regressions into loud warnings (or failures in strict
   mode).
+* :mod:`~waffle_con_tpu.runtime.liveness` — heartbeat ledger and the
+  typed :class:`~waffle_con_tpu.runtime.liveness.WorkerLost` error for
+  the out-of-process front door's worker watchdog.
 * :mod:`~waffle_con_tpu.runtime.events` — the process-wide runtime
   event log every component above records into; ``bench.py`` ships it
   in the evidence JSON.
@@ -30,6 +33,10 @@ from waffle_con_tpu.runtime.events import (  # noqa: F401
     clear_events,
     get_events,
     record,
+)
+from waffle_con_tpu.runtime.liveness import (  # noqa: F401
+    Heartbeats,
+    WorkerLost,
 )
 from waffle_con_tpu.runtime.faults import (  # noqa: F401
     FaultPlan,

@@ -34,6 +34,13 @@ in-process replicas — each with its own dispatcher, arena, worker pool
 and device slice — with least-outstanding, health-aware routing
 (``waffle_replica_*`` gauges; demoted replicas drain and re-admit).
 
+Out-of-process serving: :class:`~waffle_con_tpu.serve.procs.door.
+ProcFrontDoor` promotes that replica seam to real worker *processes*
+(own GIL, own device slice) behind a typed length-prefixed socket
+protocol (:mod:`waffle_con_tpu.serve.procs`) — same admission, aging,
+placement, and drain/shed health semantics, plus a liveness watchdog
+that requeues a crashed worker's jobs (``waffle_worker_*`` gauges).
+
 Observability: ``waffle_serve_queue_depth``/``waffle_serve_active_jobs``
 gauges, ``waffle_serve_jobs_total{outcome}`` /
 ``waffle_serve_admission_rejections_total`` /
@@ -61,6 +68,7 @@ from waffle_con_tpu.serve.job import (
     ServiceOverloaded,
 )
 from waffle_con_tpu.serve.placement import PlacementPolicy
+from waffle_con_tpu.serve.procs.door import ProcConfig, ProcFrontDoor
 from waffle_con_tpu.serve.replicas import (
     ReplicatedConfig,
     ReplicatedService,
@@ -80,6 +88,8 @@ __all__ = [
     "JobRequest",
     "JobStatus",
     "PlacementPolicy",
+    "ProcConfig",
+    "ProcFrontDoor",
     "ReplicatedConfig",
     "ReplicatedService",
     "ServeConfig",

@@ -1,0 +1,53 @@
+"""Out-of-process serving: a front-door process plus N worker
+processes speaking a thin length-prefixed socket protocol.
+
+PR 9's :class:`~waffle_con_tpu.serve.replicas.ReplicatedService`
+proved the routing/drain/shed shape with N in-process replicas, but
+those replicas share one GIL and one device pool.  This package
+promotes the same seam to real processes:
+
+* :mod:`~waffle_con_tpu.serve.procs.wire` — the frame codec: version
+  byte + checksum on every frame, JSON payloads (no pickle on the
+  wire path), typed decode errors.
+* :mod:`~waffle_con_tpu.serve.procs.worker` — the worker process
+  entrypoint (``python -m waffle_con_tpu.serve.procs.worker``): one
+  :class:`~waffle_con_tpu.serve.service.ConsensusService` per process
+  with its own dispatcher, ragged arena, worker pool, and device
+  slice, forwarding its flight-recorder triggers over the socket.
+* :mod:`~waffle_con_tpu.serve.procs.door` — the front door: owns
+  admission, anti-starvation aging, and placement; routes to the
+  least-loaded healthy worker; demotes/sheds workers from their
+  forwarded trigger stream; requeues a lost worker's jobs.
+
+Crash/requeue boundary: a drained or crashed worker's not-yet-started
+jobs are requeued verbatim; jobs that had already *started* on a
+crashed worker are restarted from scratch on a healthy worker when
+``ProcConfig.restart_lost`` is on (engines are deterministic, so the
+result is byte-identical — only the partial progress is lost).  Full
+mid-search state migration stays ROADMAP item 2.
+"""
+
+from waffle_con_tpu.serve.procs.door import ProcConfig, ProcFrontDoor
+from waffle_con_tpu.serve.procs.wire import (
+    BadChecksum,
+    FrameDecoder,
+    FrameTooLarge,
+    FrameType,
+    UnknownFrameType,
+    UnsupportedVersion,
+    WireError,
+    encode_frame,
+)
+
+__all__ = [
+    "BadChecksum",
+    "FrameDecoder",
+    "FrameTooLarge",
+    "FrameType",
+    "ProcConfig",
+    "ProcFrontDoor",
+    "UnknownFrameType",
+    "UnsupportedVersion",
+    "WireError",
+    "encode_frame",
+]

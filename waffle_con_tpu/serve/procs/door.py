@@ -1,0 +1,898 @@
+"""Out-of-process front door: admission + routing over worker processes.
+
+:class:`ProcFrontDoor` is the process-parallel sibling of
+:class:`~waffle_con_tpu.serve.replicas.ReplicatedService`: the same
+least-outstanding, health-aware routing shape, but the N replicas are
+real **worker processes** (own interpreter, own GIL, own dispatcher +
+ragged arena + device slice) reached over an AF_UNIX socket speaking
+the typed frame protocol of :mod:`waffle_con_tpu.serve.procs.wire`.
+
+The door owns everything the workers must agree on exactly once:
+
+* **admission** — one bounded priority queue with anti-starvation
+  aging (the same :class:`~waffle_con_tpu.serve.scheduler.
+  AdmissionQueue` the in-process service uses); a full queue rejects
+  with :class:`~waffle_con_tpu.serve.job.ServiceOverloaded`.
+* **placement** — :class:`~waffle_con_tpu.serve.placement.
+  PlacementPolicy` runs door-side at admission, so the mesh-vs-arena
+  decision is made once and travels to the worker inside the job's
+  config.
+* **health** — each worker forwards its flight-recorder triggers as
+  ``HEALTH`` frames; ``backend_demoted`` puts the worker in
+  ``draining`` (no new routes until its inflight set empties, then
+  automatic re-admission), ``slow_search`` in ``shedding`` for a
+  cooldown — mirroring the in-process replica semantics verbatim.
+* **liveness** — a watchdog pings every worker
+  (``WAFFLE_PROC_PING_S``) and any frame counts as a heartbeat; a dead
+  process, closed socket, or silence past ``WAFFLE_PROC_LIVENESS_S``
+  marks the worker **lost**: exactly one ``worker_lost`` flight
+  trigger fires, its not-yet-started jobs are requeued to healthy
+  workers, and its *started* jobs either restart from scratch
+  (``restart_lost=True``, the default — engines are deterministic so
+  the retried result is byte-identical) or fail with the typed
+  :class:`~waffle_con_tpu.runtime.liveness.WorkerLost`.  Restart means
+  re-running, not resuming: mid-search state migration is ROADMAP
+  item 2, not this class.
+* **observability** — ``waffle_worker_*`` gauges/counters, a
+  ``workers`` table in the ``WAFFLE_STATS_FILE`` payload (the door is
+  the only stats publisher; workers run with stats disabled), runtime
+  events for every transition.
+
+Client-side cancellation settles the door-side handle immediately;
+the worker keeps computing until its own dispatch-boundary abort and
+its late frames land on an already-terminal handle (a no-op).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Set
+
+from waffle_con_tpu.analysis import lockcheck
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import slo as obs_slo
+from waffle_con_tpu.runtime import events
+from waffle_con_tpu.runtime.liveness import Heartbeats, WorkerLost
+from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
+from waffle_con_tpu.serve.job import (
+    JobCancelled,
+    JobHandle,
+    JobRequest,
+    JobStatus,
+    ServiceClosed,
+    ServiceOverloaded,
+)
+from waffle_con_tpu.serve.procs import wire
+from waffle_con_tpu.serve.scheduler import AdmissionQueue
+from waffle_con_tpu.utils import envspec
+
+#: worker states (the first three mirror the in-process replica set)
+UP = "up"
+DRAINING = "draining"    # circuit-break: no routes until drained
+SHEDDING = "shedding"    # latency flag: deprioritized for a cooldown
+LOST = "lost"            # process dead / socket gone / liveness lapse
+
+_HEALTH_REASONS = ("backend_demoted", "slow_search")
+
+RECV_CHUNK = 1 << 16
+
+
+def ping_interval_s() -> float:
+    """``WAFFLE_PROC_PING_S`` — watchdog ping period (default 0.5 s)."""
+    return envspec.get_float("WAFFLE_PROC_PING_S", 0.5)
+
+
+def liveness_lapse_s() -> float:
+    """``WAFFLE_PROC_LIVENESS_S`` — silence before a worker is
+    declared lost (default 5 s)."""
+    return envspec.get_float("WAFFLE_PROC_LIVENESS_S", 5.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcConfig:
+    """Front-door knobs.
+
+    * ``workers`` — worker *process* count.
+    * ``worker_slots`` — concurrent jobs inside each worker (its
+      in-process ``ServeConfig.workers``).
+    * ``inflight`` — routed-but-unfinished jobs the door keeps on one
+      worker before holding further routes (default
+      ``2 * worker_slots``: one batch running, one queued behind it).
+    * ``restart_lost`` — restart a crashed worker's already-started
+      jobs from scratch on a healthy worker (deterministic engines
+      make the retried result byte-identical); off, those jobs fail
+      with :class:`~waffle_con_tpu.runtime.liveness.WorkerLost`.
+      Not-yet-started jobs are requeued either way.
+    * ``launcher`` — test seam: ``launcher(socket_path, name,
+      spec_json)`` returning a Popen-like handle (``pid``/``poll``/
+      ``terminate``/``kill``/``wait``); ``None`` spawns
+      ``python -m waffle_con_tpu.serve.procs.worker``.
+    """
+
+    workers: int = 2
+    worker_slots: int = 2
+    queue_limit: int = 64
+    batch_window_s: float = 0.002
+    max_batch: int = 8
+    name: str = "consensus"
+    adaptive_window: bool = True
+    aging_s: Optional[float] = 0.5
+    placement: Optional[object] = None
+    shed_cooldown_s: float = 2.0
+    restart_lost: bool = True
+    inflight: Optional[int] = None
+    spawn_timeout_s: float = 120.0
+    launcher: Optional[Callable[[str, str, str], Any]] = None
+
+    def __post_init__(self) -> None:
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+        if self.worker_slots < 1:
+            raise ValueError("worker_slots must be >= 1")
+        if self.queue_limit < 1:
+            raise ValueError("queue_limit must be >= 1")
+        if self.shed_cooldown_s < 0:
+            raise ValueError("shed_cooldown_s must be >= 0")
+        if self.inflight is not None and self.inflight < 1:
+            raise ValueError("inflight must be >= 1 (or None)")
+
+    @property
+    def window(self) -> int:
+        return (self.inflight if self.inflight is not None
+                else 2 * self.worker_slots)
+
+
+class _Worker:
+    """Mutable per-worker record (state guarded by the door's lock)."""
+
+    __slots__ = ("index", "name", "proc", "pid", "sock", "slots",
+                 "state", "shed_until", "assigned", "started",
+                 "routed", "demotions", "sheds", "readmits", "requeues",
+                 "reported_outstanding", "decoder", "send_lock")
+
+    def __init__(self, index: int, name: str) -> None:
+        self.index = index
+        self.name = name
+        self.proc: Any = None
+        self.pid: Optional[int] = None
+        self.sock: Optional[socket.socket] = None
+        self.slots = 1
+        self.state = UP
+        self.shed_until = 0.0
+        self.assigned: Dict[int, JobHandle] = {}
+        self.started: Set[int] = set()
+        self.routed = 0
+        self.demotions = 0
+        self.sheds = 0
+        self.readmits = 0
+        self.requeues = 0
+        self.reported_outstanding = 0
+        self.decoder = wire.FrameDecoder()
+        self.send_lock = lockcheck.make_lock(f"procs.door.send.{name}")
+
+
+class ProcFrontDoor:
+    """N worker processes behind least-outstanding, health-aware
+    routing over the typed socket protocol.
+
+    Usage::
+
+        with ProcFrontDoor(ProcConfig(workers=2)) as door:
+            handles = [door.submit(req) for req in requests]
+            results = [h.result() for h in handles]
+    """
+
+    def __init__(
+        self,
+        config: Optional[ProcConfig] = None,
+        autostart: bool = True,
+    ) -> None:
+        self.config = config if config is not None else ProcConfig()
+        self._lock = lockcheck.make_lock("serve.procs.ProcFrontDoor")
+        self._closed = False
+        self._started = False
+        self._next_id = 0
+        self._jobs: Dict[int, JobHandle] = {}
+        self._counts: Dict[str, int] = collections.defaultdict(int)
+        self._retry: Deque[JobHandle] = collections.deque()
+        self._queue = AdmissionQueue(
+            limit=self.config.queue_limit,
+            name=f"{self.config.name}.door",
+            aging_s=self.config.aging_s,
+        )
+        self._beats = Heartbeats()
+        self._stats_published_at = 0.0
+        self._stopping = False
+        self._tmpdir = tempfile.mkdtemp(prefix="waffle-procs-")
+        self._socket_path = os.path.join(self._tmpdir, "door.sock")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self._socket_path)
+        self._listener.listen(self.config.workers)
+        self._workers = [
+            _Worker(i, f"{self.config.name}:w{i}")
+            for i in range(self.config.workers)
+        ]
+        self._threads: List[Any] = []
+        if autostart:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _worker_spec(self) -> str:
+        cfg = self.config
+        return json.dumps({
+            "workers": cfg.worker_slots,
+            # the worker's own queue must absorb the door's full
+            # routing window; placement stays door-side (None here)
+            "queue_limit": max(cfg.queue_limit, cfg.window),
+            "batch_window_s": cfg.batch_window_s,
+            "max_batch": cfg.max_batch,
+            "adaptive_window": cfg.adaptive_window,
+            "aging_s": cfg.aging_s,
+        })
+
+    @staticmethod
+    def _spawn_process(socket_path: str, name: str, spec: str):
+        env = dict(os.environ)
+        # the door is the only stats publisher
+        env.pop("WAFFLE_STATS_FILE", None)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + env["PYTHONPATH"]
+                        if env.get("PYTHONPATH") else "")
+        )
+        return subprocess.Popen(
+            [sys.executable, "-m", "waffle_con_tpu.serve.procs.worker",
+             "--socket", socket_path, "--worker", name, "--spec", spec],
+            env=env,
+        )
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self._closed:
+                return
+            self._started = True
+        spec = self._worker_spec()
+        launcher = self.config.launcher or self._spawn_process
+        for worker in self._workers:
+            worker.proc = launcher(self._socket_path, worker.name, spec)
+        deadline = time.monotonic() + self.config.spawn_timeout_s
+        pending = {w.name: w for w in self._workers}
+        while pending:
+            self._listener.settimeout(
+                max(0.1, deadline - time.monotonic())
+            )
+            try:
+                conn, _ = self._listener.accept()
+            except (socket.timeout, OSError):
+                raise RuntimeError(
+                    f"worker handshake timed out; still waiting for "
+                    f"{sorted(pending)}"
+                ) from None
+            hello, trailing, decoder = self._handshake(conn, deadline)
+            worker = pending.pop(hello["worker"], None)
+            if worker is None:
+                conn.close()
+                continue
+            worker.sock = conn
+            worker.decoder = decoder
+            worker.pid = int(hello.get("pid", 0)) or None
+            worker.slots = int(hello.get("slots", 1))
+            self._beats.beat(worker.name)
+            for ftype, obj in trailing:
+                self._on_frame(worker, ftype, obj)
+        for worker in self._workers:
+            thread = lockcheck.make_thread(
+                target=self._read_loop, args=(worker,),
+                name=f"procs.door.read.{worker.name}", daemon=True,
+            )
+            thread.start()
+            self._threads.append(thread)
+        router = lockcheck.make_thread(
+            target=self._route_loop, name="procs.door.router", daemon=True,
+        )
+        router.start()
+        self._threads.append(router)
+        watchdog = lockcheck.make_thread(
+            target=self._watch_loop, name="procs.door.watchdog",
+            daemon=True,
+        )
+        watchdog.start()
+        self._threads.append(watchdog)
+        events.record(
+            "procs_door_up", service=self.config.name,
+            workers=len(self._workers),
+        )
+
+    @staticmethod
+    def _handshake(conn: socket.socket, deadline: float):
+        """Read frames until HELLO.  Returns the HELLO payload plus any
+        frames that rode in the same chunk and the primed decoder —
+        the caller must adopt both, or an eager worker's first HEALTH /
+        STARTED frame would be silently dropped."""
+        decoder = wire.FrameDecoder()
+        while True:
+            conn.settimeout(max(0.1, deadline - time.monotonic()))
+            data = conn.recv(RECV_CHUNK)
+            if not data:
+                raise RuntimeError("worker closed during handshake")
+            frames = decoder.feed(data)
+            if not frames:
+                continue
+            ftype, obj = frames[0]
+            if ftype is not wire.FrameType.HELLO:
+                raise RuntimeError(f"expected HELLO, got {ftype.name}")
+            conn.settimeout(None)
+            return obj, frames[1:], decoder
+
+    def close(
+        self, cancel_pending: bool = False, timeout: Optional[float] = None
+    ) -> None:
+        """Shut down.  Default drains gracefully: everything already
+        admitted runs to completion first.  ``cancel_pending=True``
+        cancels still-queued jobs instead."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._queue.close()
+        if cancel_pending:
+            leftovers = self._queue.drain()
+            with self._lock:
+                leftovers.extend(self._retry)
+                self._retry.clear()
+            for handle in leftovers:
+                handle._finish(
+                    JobStatus.CANCELLED,
+                    exception=ServiceClosed("service closed"),
+                )
+        budget = timeout if timeout is not None else 60.0
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            with self._lock:
+                inflight = any(w.assigned for w in self._workers)
+                pending = bool(self._retry) or self._queue.depth() > 0
+            if not inflight and not pending:
+                break
+            time.sleep(0.02)
+        self._stopping = True
+        for worker in self._workers:
+            if worker.state != LOST and worker.sock is not None:
+                self._send(worker, wire.FrameType.SHUTDOWN, {})
+        for worker in self._workers:
+            proc = worker.proc
+            if proc is None or not hasattr(proc, "wait"):
+                continue
+            try:
+                proc.wait(timeout=5.0)
+            except Exception:  # noqa: BLE001 - escalate to terminate/kill
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=2.0)
+                except Exception:  # noqa: BLE001
+                    try:
+                        proc.kill()
+                    except Exception:  # noqa: BLE001
+                        pass
+            if worker.sock is not None:
+                try:
+                    worker.sock.close()
+                except OSError:
+                    pass
+            self._beats.forget(worker.name)
+        try:
+            self._listener.close()
+            os.unlink(self._socket_path)
+            os.rmdir(self._tmpdir)
+        except OSError:
+            pass
+        # anything still unfinished is orphaned by shutdown
+        with self._lock:
+            orphans = [h for h in self._jobs.values() if not h.done()]
+        for handle in orphans:
+            handle._finish(
+                JobStatus.CANCELLED,
+                exception=ServiceClosed("service closed before the job "
+                                        "finished"),
+            )
+        events.record("procs_door_down", service=self.config.name)
+        self._publish_stats(force=True)
+
+    def __enter__(self) -> "ProcFrontDoor":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    # -- client API ----------------------------------------------------
+
+    def submit(self, request: JobRequest) -> JobHandle:
+        """Admit one job; raises :class:`ServiceOverloaded` when the
+        bounded queue is full and :class:`ServiceClosed` after close."""
+        if not isinstance(request, JobRequest):
+            raise TypeError(
+                f"expected JobRequest, got {type(request).__name__}"
+            )
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is closed to new jobs")
+        request = self._place(request)
+        with self._lock:
+            job_id = self._next_id
+            self._next_id += 1
+            handle = JobHandle(job_id, request, service=self.config.name)
+            self._jobs[job_id] = handle
+            self._counts["submitted"] += 1
+        try:
+            self._queue.put(handle)
+        except (ServiceOverloaded, ServiceClosed):
+            with self._lock:
+                self._counts["submitted"] -= 1
+                del self._jobs[job_id]
+            raise
+        self._publish_stats()
+        return handle
+
+    def submit_all(self, requests: Sequence[JobRequest]) -> List[JobHandle]:
+        return [self.submit(r) for r in requests]
+
+    def outstanding(self) -> int:
+        """Admitted-but-unfinished job count (queued + routed)."""
+        with self._lock:
+            return sum(1 for h in self._jobs.values() if not h.done())
+
+    def _place(self, request: JobRequest) -> JobRequest:
+        """Door-side placement (the decision travels in the config)."""
+        policy = self.config.placement
+        if policy is None:
+            return request
+        try:
+            from waffle_con_tpu.parallel import mesh as par_mesh
+
+            placed = policy.place(request, par_mesh.probe_device_count())
+        except Exception:  # noqa: BLE001 - jax-less stack, probe failure
+            return request
+        if placed is None:
+            return request
+        with self._lock:
+            self._counts["mesh_placed"] += 1
+        events.record(
+            "job_placed_mesh", job_kind=request.kind,
+            reads=len(request.reads),
+            shards=placed.config.mesh_shards,
+            service=self.config.name,
+        )
+        return placed
+
+    # -- routing -------------------------------------------------------
+
+    def _route_loop(self) -> None:
+        while True:
+            handle: Optional[JobHandle] = None
+            with self._lock:
+                if self._retry:
+                    handle = self._retry.popleft()
+            if handle is None:
+                handle = self._queue.get(timeout=0.1)
+            if handle is None:
+                with self._lock:
+                    drained = (self._closed and not self._retry)
+                if drained and self._queue.depth() == 0:
+                    return
+                continue
+            if handle.done():
+                continue  # cancelled while queued
+            self._route_one(handle)
+
+    def _route_one(self, handle: JobHandle) -> None:
+        """Assign one job to the best worker, holding it while no
+        worker has window capacity (bounded by close)."""
+        while True:
+            self._maintain()
+            worker = None
+            with self._lock:
+                if self._closed and self._stopping:
+                    break
+                window = self.config.window
+                ranked = sorted(
+                    (w for w in self._workers if w.state != LOST),
+                    key=lambda w: (0 if w.state == UP else 1,
+                                   len(w.assigned), w.index),
+                )
+                healthy = [w for w in ranked if w.state == UP]
+                pool = healthy or ranked
+                with_room = [w for w in pool if len(w.assigned) < window]
+                if not with_room and healthy and len(healthy) < len(ranked):
+                    # healthy tier full: overflow onto the remainder
+                    with_room = [
+                        w for w in ranked
+                        if w not in healthy and len(w.assigned) < window
+                    ]
+                if with_room:
+                    worker = with_room[0]
+                    worker.assigned[handle.job_id] = handle
+                    worker.routed += 1
+            if worker is None:
+                if handle.done():
+                    return
+                time.sleep(0.01)
+                continue
+            if self._dispatch(worker, handle):
+                self._publish_worker_metrics(worker)
+                self._publish_stats()
+            # on dispatch failure the handle was already expired or
+            # pushed back onto the retry deque — either way this
+            # routing attempt is over
+            return
+        handle._finish(
+            JobStatus.CANCELLED,
+            exception=ServiceClosed("service closed before the job "
+                                    "was routed"),
+        )
+
+    def _dispatch(self, worker: _Worker, handle: JobHandle) -> bool:
+        """Send one SUBMIT; on failure unassign and expire/requeue."""
+        deadline_left = None
+        if handle.deadline is not None:
+            deadline_left = handle.deadline - time.monotonic()
+            if deadline_left <= 0:
+                with self._lock:
+                    worker.assigned.pop(handle.job_id, None)
+                handle._finish(
+                    JobStatus.EXPIRED,
+                    exception=DeadlineExceeded(
+                        f"job {handle.job_id} deadline lapsed before "
+                        "routing"
+                    ),
+                )
+                return False
+        frame = wire.encode_frame(wire.FrameType.SUBMIT, {
+            "job": handle.job_id,
+            "request": wire.encode_request(
+                handle.request, deadline_left_s=deadline_left
+            ),
+        })
+        try:
+            with worker.send_lock:
+                worker.sock.sendall(frame)
+            return True
+        except OSError:
+            with self._lock:
+                worker.assigned.pop(handle.job_id, None)
+                self._retry.append(handle)
+            return False
+
+    def _send(self, worker: _Worker, ftype: wire.FrameType,
+              obj: Any) -> None:
+        if worker.sock is None:
+            return
+        try:
+            frame = wire.encode_frame(ftype, obj)
+            with worker.send_lock:
+                worker.sock.sendall(frame)
+        except OSError:
+            pass  # the reader/watchdog will declare the worker lost
+
+    # -- worker frames -------------------------------------------------
+
+    def _read_loop(self, worker: _Worker) -> None:
+        while True:
+            try:
+                data = worker.sock.recv(RECV_CHUNK)
+            except OSError:
+                data = b""
+            if not data:
+                self._worker_lost(worker, "socket closed")
+                return
+            self._beats.beat(worker.name)
+            try:
+                frames = worker.decoder.feed(data)
+            except wire.WireError as exc:
+                self._worker_lost(worker, f"protocol error: {exc}")
+                return
+            for ftype, obj in frames:
+                self._on_frame(worker, ftype, obj)
+
+    def _on_frame(self, worker: _Worker, ftype: wire.FrameType,
+                  obj: Any) -> None:
+        if ftype is wire.FrameType.STARTED:
+            job_id = int(obj["job"])
+            with self._lock:
+                handle = worker.assigned.get(job_id)
+                if handle is not None:
+                    worker.started.add(job_id)
+            if handle is not None:
+                handle._mark_running()
+        elif ftype is wire.FrameType.RESULT:
+            self._on_result(worker, obj)
+        elif ftype is wire.FrameType.ERROR:
+            self._on_error(worker, obj)
+        elif ftype is wire.FrameType.HEALTH:
+            self._apply_health(worker, obj)
+        elif ftype is wire.FrameType.PONG:
+            with self._lock:
+                worker.reported_outstanding = int(
+                    obj.get("outstanding", 0)
+                )
+        # HELLO repeats and unknown-but-valid frames are ignored
+
+    def _take_assigned(self, worker: _Worker,
+                       job_id: int) -> Optional[JobHandle]:
+        with self._lock:
+            worker.started.discard(job_id)
+            return worker.assigned.pop(job_id, None)
+
+    def _on_result(self, worker: _Worker, obj: Dict) -> None:
+        handle = self._take_assigned(worker, int(obj["job"]))
+        if handle is None:
+            return
+        try:
+            result = wire.decode_result(obj["kind"], obj["result"])
+        except wire.WireError as exc:
+            handle._finish(JobStatus.FAILED, exception=exc)
+            return
+        handle._finish(JobStatus.DONE, result=result)
+        if handle.latency_s is not None:
+            obs_slo.observe_job(handle.latency_s)
+        self._publish_worker_metrics(worker)
+        self._publish_stats()
+
+    def _on_error(self, worker: _Worker, obj: Dict) -> None:
+        handle = self._take_assigned(worker, int(obj["job"]))
+        if handle is None:
+            return
+        kind = obj.get("kind", "failed")
+        message = obj.get("message", "")
+        if kind == "cancelled":
+            handle._finish(
+                JobStatus.CANCELLED, exception=JobCancelled(message)
+            )
+        elif kind == "expired":
+            handle._finish(
+                JobStatus.EXPIRED, exception=DeadlineExceeded(message)
+            )
+        else:
+            handle._finish(
+                JobStatus.FAILED,
+                exception=RuntimeError(
+                    f"worker {worker.name} failed job: "
+                    f"{obj.get('type', 'Error')}: {message}"
+                ),
+            )
+        self._publish_stats()
+
+    # -- health --------------------------------------------------------
+
+    def _apply_health(self, worker: _Worker, obj: Dict) -> None:
+        """A forwarded flight trigger from this worker's own recorder;
+        attribution is the connection itself (no trace parsing)."""
+        reason = obj.get("reason")
+        if reason not in _HEALTH_REASONS:
+            return
+        with self._lock:
+            if self._closed or worker.state == LOST:
+                return
+            if reason == "backend_demoted":
+                worker.demotions += 1
+                if worker.state != DRAINING:
+                    worker.state = DRAINING
+                    events.record(
+                        "worker_draining", worker=worker.name,
+                        trigger=reason, trace_id=obj.get("trace"),
+                    )
+            else:  # slow_search
+                worker.sheds += 1
+                if worker.state == UP:
+                    worker.state = SHEDDING
+                worker.shed_until = (
+                    time.monotonic() + self.config.shed_cooldown_s
+                )
+                events.record(
+                    "worker_shedding", worker=worker.name,
+                    trigger=reason, trace_id=obj.get("trace"),
+                )
+        self._publish_worker_metrics(worker)
+
+    def _maintain(self) -> None:
+        """Lazy health maintenance at each routing decision: re-admit
+        drained workers, expire shed cooldowns."""
+        now = time.monotonic()
+        readmitted = []
+        with self._lock:
+            for worker in self._workers:
+                if worker.state == DRAINING and not worker.assigned:
+                    worker.state = UP
+                    worker.readmits += 1
+                    readmitted.append(worker)
+                elif worker.state == SHEDDING and now >= worker.shed_until:
+                    worker.state = UP
+        for worker in readmitted:
+            events.record("worker_readmitted", worker=worker.name)
+            self._publish_worker_metrics(worker)
+
+    # -- liveness ------------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        while True:
+            time.sleep(ping_interval_s())
+            with self._lock:
+                if self._closed:
+                    return
+                workers = [w for w in self._workers if w.state != LOST]
+            lapse = liveness_lapse_s()
+            for worker in workers:
+                rc = None
+                if worker.proc is not None and hasattr(worker.proc, "poll"):
+                    rc = worker.proc.poll()
+                if rc is not None:
+                    self._worker_lost(
+                        worker, f"process exited with code {rc}"
+                    )
+                    continue
+                age = self._beats.age(worker.name)
+                if age is not None and age > lapse:
+                    self._worker_lost(
+                        worker, f"no frames for {age:.1f}s "
+                        f"(liveness lapse {lapse:.1f}s)"
+                    )
+                    continue
+                self._send(worker, wire.FrameType.PING, {})
+
+    def _worker_lost(self, worker: _Worker, why: str) -> None:
+        """Idempotently transition one worker to LOST: requeue its
+        not-yet-started jobs (and, with ``restart_lost``, restart its
+        started ones from scratch), fail the rest with
+        :class:`WorkerLost`, fire exactly one ``worker_lost`` flight
+        trigger."""
+        with self._lock:
+            if self._closed or worker.state == LOST:
+                return
+            worker.state = LOST
+            assigned = dict(worker.assigned)
+            started = set(worker.started)
+            worker.assigned.clear()
+            worker.started.clear()
+        if worker.sock is not None:
+            try:
+                worker.sock.close()
+            except OSError:
+                pass
+        self._beats.forget(worker.name)
+        events.record(
+            "worker_lost", worker=worker.name, why=why,
+            jobs=len(assigned),
+        )
+        obs_flight.trigger(
+            "worker_lost", trace_id=worker.name, why=why,
+            service=self.config.name, jobs_assigned=len(assigned),
+        )
+        requeued = 0
+        for job_id, handle in sorted(assigned.items()):
+            if handle.done():
+                continue
+            if job_id not in started or self.config.restart_lost:
+                with self._lock:
+                    worker.requeues += 1
+                    self._retry.append(handle)
+                requeued += 1
+            else:
+                handle._finish(
+                    JobStatus.FAILED,
+                    exception=WorkerLost(
+                        f"worker {worker.name} lost ({why}) while "
+                        f"running job {job_id}"
+                    ),
+                )
+        if obs_metrics.metrics_enabled():
+            reg = obs_metrics.registry()
+            labels = {"service": self.config.name, "worker": worker.name}
+            reg.counter("waffle_worker_lost_total", **labels).inc()
+            reg.counter(
+                "waffle_worker_requeued_total", **labels
+            ).inc(requeued)
+        self._publish_worker_metrics(worker)
+        self._publish_stats()
+
+    # -- observability -------------------------------------------------
+
+    def _publish_worker_metrics(self, worker: _Worker) -> None:
+        if not obs_metrics.metrics_enabled():
+            return
+        reg = obs_metrics.registry()
+        labels = {"service": self.config.name, "worker": worker.name}
+        with self._lock:
+            outstanding = len(worker.assigned)
+            state = worker.state
+            routed = worker.routed
+            demotions = worker.demotions
+            sheds = worker.sheds
+        reg.gauge("waffle_worker_outstanding", **labels).set(outstanding)
+        reg.gauge("waffle_worker_healthy", **labels).set(
+            1 if state == UP else 0
+        )
+        reg.gauge("waffle_worker_routed", **labels).set(routed)
+        reg.gauge("waffle_worker_demotions", **labels).set(demotions)
+        reg.gauge("waffle_worker_sheds", **labels).set(sheds)
+
+    def worker_stats(self) -> List[Dict]:
+        """Per-worker snapshot (the ``workers`` table in stats payloads
+        and storm evidence)."""
+        out = []
+        with self._lock:
+            for worker in self._workers:
+                outstanding = len(worker.assigned)
+                out.append({
+                    "worker": worker.name,
+                    "pid": worker.pid,
+                    "state": worker.state,
+                    "outstanding": outstanding,
+                    "slots": worker.slots,
+                    "occupancy": (outstanding / worker.slots
+                                  if worker.slots else 0.0),
+                    "routed": worker.routed,
+                    "requeues": worker.requeues,
+                    "demotions": worker.demotions,
+                    "sheds": worker.sheds,
+                    "readmits": worker.readmits,
+                })
+        return out
+
+    def stats(self) -> Dict:
+        """Aggregated counters plus the per-worker table."""
+        with self._lock:
+            # fold terminal handles into the cumulative counts, then
+            # drop them so the jobs dict stays bounded
+            for job_id in [j for j, h in self._jobs.items() if h.done()]:
+                self._counts[self._jobs.pop(job_id).status.value] += 1
+            counts = dict(self._counts)
+        return {
+            "jobs": counts,
+            "queue_depth": self._queue.depth(),
+            "aged_pops": self._queue.aged_pops,
+            "workers": self.worker_stats(),
+        }
+
+    def _publish_stats(self, force: bool = False) -> None:
+        """Front-door-owned ``WAFFLE_STATS_FILE`` publication (same
+        throttle + atomic-rename contract as the replica door; the
+        payload gains a top-level ``workers`` table)."""
+        path = envspec.get_raw("WAFFLE_STATS_FILE", "")
+        if not path:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._stats_published_at < 0.25:
+                return
+            self._stats_published_at = now
+        stats = self.stats()
+        payload = {
+            "service": self.config.name,
+            "unix_time": time.time(),
+            "stats": stats,
+            "workers": stats["workers"],
+            "slo": obs_slo.snapshot(),
+            "incidents": [
+                {k: i.get(k) for k in
+                 ("seq", "reason", "trace_id", "unix_time", "path")}
+                for i in obs_flight.incidents()[-8:]
+            ],
+        }
+        if obs_metrics.metrics_enabled():
+            payload["metrics"] = obs_metrics.registry().snapshot()
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, default=repr)
+            os.replace(tmp, path)
+        except OSError:  # a broken stats sink must never fail a job
+            pass

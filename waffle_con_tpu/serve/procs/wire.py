@@ -1,0 +1,350 @@
+"""Length-prefixed frame codec for the out-of-process serving wire.
+
+Every frame is a fixed 10-byte header followed by the payload::
+
+    !BBII  =  version(1)  frame_type(1)  payload_len(4)  crc32(4)
+
+and every payload is JSON (bytes carried as base64) — **never pickle**:
+a worker socket is a process boundary and the decoder must not execute
+anything the peer sent.  The CRC32 covers the payload only; a mismatch
+is a typed :class:`BadChecksum`, a future version byte is a typed
+:class:`UnsupportedVersion`, an oversized declared length is a typed
+:class:`FrameTooLarge` — decoding never hangs on a torn frame (partial
+input just stays buffered in the :class:`FrameDecoder`) and never
+raises anything untyped on garbage input.
+
+The config/request/result codecs below are explicit field-by-field
+translations (no ``__dict__`` reflection on the decode side): unknown
+fields from a newer peer are dropped, enums travel as their ``.value``,
+and decoded objects are rebuilt through their real constructors so the
+existing ``__eq__``-based byte-parity checks apply unchanged.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import enum
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.utils import envspec
+
+#: Protocol version stamped on (and required of) every frame.
+FRAME_VERSION = 1
+
+#: version(1) type(1) payload_len(4) crc32(4), network byte order.
+HEADER = struct.Struct("!BBII")
+
+
+class FrameType(enum.IntEnum):
+    """Typed frames of the door<->worker protocol."""
+
+    HELLO = 1        #: worker -> door: {worker, pid, slots}
+    SUBMIT = 2       #: door -> worker: {job, request}
+    STARTED = 3      #: worker -> door: {job}
+    RESULT = 4       #: worker -> door: {job, kind, result}
+    ERROR = 5        #: worker -> door: {job, kind, type, message}
+    HEALTH = 6       #: worker -> door: forwarded flight trigger
+    PING = 7         #: door -> worker: liveness probe
+    PONG = 8         #: worker -> door: {outstanding, occupancy}
+    DRAIN = 9        #: door -> worker: stop accepting, finish inflight
+    SHUTDOWN = 10    #: door -> worker: close service and exit
+
+
+class WireError(RuntimeError):
+    """Base class for frame-codec errors (never a hang, never pickle)."""
+
+
+class FrameTooLarge(WireError):
+    """Declared payload length exceeds ``WAFFLE_PROC_FRAME_MAX``."""
+
+
+class BadChecksum(WireError):
+    """Payload CRC32 does not match the header."""
+
+
+class UnsupportedVersion(WireError):
+    """Frame from a peer speaking a different protocol version."""
+
+
+class UnknownFrameType(WireError):
+    """Well-formed frame with a type byte this side does not know."""
+
+
+def max_payload() -> int:
+    """``WAFFLE_PROC_FRAME_MAX`` — upper bound on one frame's payload
+    (default 32 MiB; floor 4 KiB so headers always fit a sane job)."""
+    return envspec.get_int("WAFFLE_PROC_FRAME_MAX", 32 * 1024 * 1024,
+                           lo=4096)
+
+
+def encode_frame(ftype: int, obj: Any) -> bytes:
+    """One wire frame: header + JSON payload for ``obj``."""
+    payload = json.dumps(obj, separators=(",", ":"),
+                         allow_nan=False).encode("utf-8")
+    if len(payload) > max_payload():
+        raise FrameTooLarge(
+            f"frame payload {len(payload)} bytes exceeds "
+            f"WAFFLE_PROC_FRAME_MAX={max_payload()}"
+        )
+    return HEADER.pack(
+        FRAME_VERSION, int(ftype), len(payload), zlib.crc32(payload)
+    ) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser over a byte stream.
+
+    :meth:`feed` buffers arbitrary chunks (a torn frame simply waits
+    for more bytes — there is no blocking read anywhere in the codec)
+    and returns every frame completed so far as ``(FrameType, obj)``
+    pairs.  Malformed input raises the typed :class:`WireError`
+    subclasses; after an error the stream is unrecoverable by design
+    (framing is lost), so callers drop the connection.
+    """
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def pending(self) -> int:
+        """Bytes buffered but not yet parsed into a full frame."""
+        return len(self._buf)
+
+    def feed(self, data: bytes) -> List[Tuple[FrameType, Any]]:
+        self._buf += data
+        frames: List[Tuple[FrameType, Any]] = []
+        while True:
+            if len(self._buf) < HEADER.size:
+                return frames
+            version, ftype, length, crc = HEADER.unpack_from(self._buf)
+            if version != FRAME_VERSION:
+                raise UnsupportedVersion(
+                    f"frame version {version} (speaking {FRAME_VERSION})"
+                )
+            if length > max_payload():
+                raise FrameTooLarge(
+                    f"declared payload {length} bytes exceeds "
+                    f"WAFFLE_PROC_FRAME_MAX={max_payload()}"
+                )
+            if len(self._buf) < HEADER.size + length:
+                return frames
+            payload = bytes(self._buf[HEADER.size:HEADER.size + length])
+            del self._buf[:HEADER.size + length]
+            if zlib.crc32(payload) != crc:
+                raise BadChecksum(
+                    f"payload CRC mismatch on frame type {ftype}"
+                )
+            try:
+                kind = FrameType(ftype)
+            except ValueError:
+                raise UnknownFrameType(f"unknown frame type {ftype}")
+            try:
+                obj = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise WireError(f"undecodable payload: {exc}") from None
+            frames.append((kind, obj))
+
+
+# -- bytes-in-JSON helpers ---------------------------------------------
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(bytes(data)).decode("ascii")
+
+
+def _unb64(text: str) -> bytes:
+    try:
+        return base64.b64decode(text.encode("ascii"), validate=True)
+    except Exception as exc:
+        raise WireError(f"bad base64 field: {exc}") from None
+
+
+# -- config codec ------------------------------------------------------
+
+def encode_config(config: Optional[CdwfaConfig]) -> Optional[Dict]:
+    """A :class:`CdwfaConfig` as plain JSON types (enum -> value,
+    tuple -> list); ``None`` passes through."""
+    if config is None:
+        return None
+    out: Dict[str, Any] = {}
+    for field in dataclasses.fields(config):
+        value = getattr(config, field.name)
+        if isinstance(value, ConsensusCost):
+            value = value.value
+        elif isinstance(value, tuple):
+            value = list(value)
+        out[field.name] = value
+    return out
+
+
+_CONFIG_FIELDS = frozenset(
+    f.name for f in dataclasses.fields(CdwfaConfig)
+)
+
+
+def decode_config(obj: Optional[Dict]) -> Optional[CdwfaConfig]:
+    """Rebuild a :class:`CdwfaConfig`, dropping unknown fields so a
+    newer peer cannot crash an older worker with an extra knob."""
+    if obj is None:
+        return None
+    if not isinstance(obj, dict):
+        raise WireError("config payload must be an object")
+    kwargs = {k: v for k, v in obj.items() if k in _CONFIG_FIELDS}
+    if "consensus_cost" in kwargs:
+        kwargs["consensus_cost"] = ConsensusCost(kwargs["consensus_cost"])
+    if kwargs.get("backend_chain") is not None:
+        kwargs["backend_chain"] = tuple(kwargs["backend_chain"])
+    try:
+        return CdwfaConfig(**kwargs)
+    except (TypeError, ValueError) as exc:
+        raise WireError(f"bad config payload: {exc}") from None
+
+
+# -- request codec -----------------------------------------------------
+
+def encode_request(request, deadline_left_s: Optional[float] = None) -> Dict:
+    """A :class:`~waffle_con_tpu.serve.job.JobRequest` as JSON.
+
+    ``deadline_left_s`` replaces the request's original budget with the
+    *remaining* budget as computed by the door — the worker's clock
+    starts at its own submit, so the wall-clock deadline keeps meaning
+    across the process boundary.
+    """
+    if request.kind == "priority":
+        reads: Any = [[_b64(s) for s in chain] for chain in request.reads]
+    else:
+        reads = [_b64(r) for r in request.reads]
+    return {
+        "kind": request.kind,
+        "reads": reads,
+        "config": encode_config(request.config),
+        "offsets": (list(request.offsets)
+                    if request.offsets is not None else None),
+        "priority": request.priority,
+        "deadline_s": (deadline_left_s if deadline_left_s is not None
+                       else request.deadline_s),
+        "tag": request.tag,
+    }
+
+
+def decode_request(obj: Dict):
+    """Rebuild a :class:`~waffle_con_tpu.serve.job.JobRequest` (its
+    own ``__post_init__`` validation applies on this side too)."""
+    from waffle_con_tpu.serve.job import JobRequest
+
+    if not isinstance(obj, dict):
+        raise WireError("request payload must be an object")
+    try:
+        kind = obj["kind"]
+        if kind == "priority":
+            reads: Any = tuple(
+                tuple(_unb64(s) for s in chain) for chain in obj["reads"]
+            )
+        else:
+            reads = tuple(_unb64(r) for r in obj["reads"])
+        offsets = obj.get("offsets")
+        return JobRequest(
+            kind=kind,
+            reads=reads,
+            config=decode_config(obj.get("config")),
+            offsets=tuple(offsets) if offsets is not None else None,
+            priority=int(obj.get("priority", 0)),
+            deadline_s=obj.get("deadline_s"),
+            tag=obj.get("tag"),
+        )
+    except WireError:
+        raise
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WireError(f"bad request payload: {exc}") from None
+
+
+# -- result codec ------------------------------------------------------
+#
+# The model classes pull the engine modules, so import them lazily:
+# the door decodes results without ever importing an engine.
+
+def _encode_consensus(c) -> Dict:
+    return {
+        "sequence": _b64(c.sequence),
+        "cost": c.consensus_cost.value,
+        "scores": list(c.scores),
+    }
+
+
+def _decode_consensus(obj: Dict):
+    from waffle_con_tpu.models.consensus import Consensus
+
+    return Consensus(
+        sequence=_unb64(obj["sequence"]),
+        consensus_cost=ConsensusCost(obj["cost"]),
+        scores=list(obj["scores"]),
+    )
+
+
+def encode_result(kind: str, result: Any) -> Any:
+    """The engine result for one finished job as JSON (tagged by the
+    request's ``kind``; every variant roundtrips through ``__eq__``)."""
+    if kind == "single":
+        return [_encode_consensus(c) for c in result]
+    if kind == "dual":
+        return [
+            {
+                "consensus1": _encode_consensus(d.consensus1),
+                "consensus2": (_encode_consensus(d.consensus2)
+                               if d.consensus2 is not None else None),
+                "is_consensus1": list(d.is_consensus1),
+                "scores1": list(d.scores1),
+                "scores2": list(d.scores2),
+            }
+            for d in result
+        ]
+    if kind == "priority":
+        return {
+            "consensuses": [
+                [_encode_consensus(c) for c in tier]
+                for tier in result.consensuses
+            ],
+            "sequence_indices": list(result.sequence_indices),
+        }
+    raise WireError(f"unknown result kind {kind!r}")
+
+
+def decode_result(kind: str, obj: Any) -> Any:
+    """Inverse of :func:`encode_result`."""
+    try:
+        if kind == "single":
+            return [_decode_consensus(c) for c in obj]
+        if kind == "dual":
+            from waffle_con_tpu.models.dual_consensus import DualConsensus
+
+            return [
+                DualConsensus(
+                    consensus1=_decode_consensus(d["consensus1"]),
+                    consensus2=(_decode_consensus(d["consensus2"])
+                                if d["consensus2"] is not None else None),
+                    is_consensus1=list(d["is_consensus1"]),
+                    scores1=list(d["scores1"]),
+                    scores2=list(d["scores2"]),
+                )
+                for d in obj
+            ]
+        if kind == "priority":
+            from waffle_con_tpu.models.priority_consensus import (
+                PriorityConsensus,
+            )
+
+            return PriorityConsensus(
+                consensuses=[
+                    [_decode_consensus(c) for c in tier]
+                    for tier in obj["consensuses"]
+                ],
+                sequence_indices=list(obj["sequence_indices"]),
+            )
+    except WireError:
+        raise
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WireError(f"bad result payload: {exc}") from None
+    raise WireError(f"unknown result kind {kind!r}")

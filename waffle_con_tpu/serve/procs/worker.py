@@ -1,0 +1,234 @@
+"""Worker-process entrypoint: one ConsensusService behind a socket.
+
+Launched by the front door as ``python -m
+waffle_con_tpu.serve.procs.worker --socket PATH --worker NAME --spec
+JSON``.  Each worker owns a full in-process serving stack — batching
+dispatcher, ragged arena, worker pool, device slice — exactly the
+stack a single-process :class:`~waffle_con_tpu.serve.service.
+ConsensusService` runs, so results are byte-identical by construction;
+the only new code on this side is the socket plumbing.
+
+Protocol (see :mod:`waffle_con_tpu.serve.procs.wire`):
+
+* connect, send ``HELLO {worker, pid, slots}``;
+* every ``SUBMIT`` is decoded (typed codec, never pickle), submitted
+  locally, and watched by a per-job thread that reports ``STARTED``
+  when the job actually runs, then exactly one of ``RESULT`` /
+  ``ERROR`` (kind ``cancelled`` / ``expired`` / ``failed``);
+* every local flight-recorder trigger is forwarded as a ``HEALTH``
+  frame so the door can attribute demotions and slow searches to this
+  worker without any shared memory;
+* ``PING`` answers ``PONG {outstanding, slots}``; ``DRAIN`` rejects
+  further submits while inflight jobs finish; ``SHUTDOWN`` (or socket
+  EOF — the door died) closes the service and exits.
+
+The module stays import-light (stdlib + wire) until :func:`main`
+actually builds the service, so spawning N workers does not pay N
+eager jax imports before the handshake.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+from typing import Any, Dict, Optional
+
+from waffle_con_tpu.serve.procs import wire
+
+RECV_CHUNK = 1 << 16
+
+
+def _json_safe(detail: Dict) -> Dict:
+    """Flight trigger details can hold arbitrary objects; the wire
+    carries strings."""
+    out = {}
+    for key, value in detail.items():
+        out[str(key)] = (value if isinstance(value, (int, float, bool,
+                                                     str, type(None)))
+                         else str(value))
+    return out
+
+
+class _Worker:
+    """Socket-side state for one worker process."""
+
+    def __init__(self, sock: socket.socket, name: str, spec: Dict) -> None:
+        from waffle_con_tpu.analysis import lockcheck
+        from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+
+        self._sock = sock
+        self._name = name
+        self._decoder = wire.FrameDecoder()
+        self._send_lock = lockcheck.make_lock("procs.worker.send")
+        self._make_thread = lockcheck.make_thread
+        self._draining = False
+        self._slots = int(spec.get("workers", 2))
+        self._service = ConsensusService(
+            ServeConfig(
+                workers=self._slots,
+                queue_limit=int(spec.get("queue_limit", 64)),
+                batch_window_s=float(spec.get("batch_window_s", 0.002)),
+                max_batch=int(spec.get("max_batch", 8)),
+                adaptive_window=bool(spec.get("adaptive_window", True)),
+                aging_s=spec.get("aging_s", 0.5),
+                name=name,
+            ),
+            publish_stats=False,
+        )
+        # share the on-disk XLA cache across the worker fleet so N
+        # processes pay each kernel compile once, not N times
+        try:
+            from waffle_con_tpu.utils.cache import enable_compilation_cache
+
+            enable_compilation_cache()
+        except Exception:  # noqa: BLE001 - jax-less stack serves fine
+            pass
+
+    # -- sends (serialized: frames must never interleave) --------------
+
+    def send(self, ftype: wire.FrameType, obj: Any) -> None:
+        frame = wire.encode_frame(ftype, obj)
+        try:
+            with self._send_lock:
+                self._sock.sendall(frame)
+        except OSError:
+            pass  # door gone; the reader loop will see EOF and exit
+
+    # -- flight trigger forwarding -------------------------------------
+
+    def on_trigger(self, reason: str, trace_id: Optional[str],
+                   detail: Dict) -> None:
+        self.send(wire.FrameType.HEALTH, {
+            "worker": self._name,
+            "reason": reason,
+            "trace": trace_id,
+            "detail": _json_safe(detail),
+        })
+
+    # -- frame handlers ------------------------------------------------
+
+    def _watch(self, job_id: int, handle) -> None:
+        """Report one job's lifecycle back to the door, in order."""
+        from waffle_con_tpu.serve.job import JobStatus
+
+        handle.wait_running()
+        if handle.started_at is not None:
+            self.send(wire.FrameType.STARTED, {"job": job_id})
+        handle.wait()
+        status = handle.status
+        if status is JobStatus.DONE:
+            self.send(wire.FrameType.RESULT, {
+                "job": job_id,
+                "kind": handle.request.kind,
+                "result": wire.encode_result(
+                    handle.request.kind, handle.result(timeout=0)
+                ),
+            })
+            return
+        try:
+            handle.result(timeout=0)
+            exc: BaseException = RuntimeError("job failed without exception")
+        except BaseException as caught:  # noqa: BLE001 — reported, not handled
+            exc = caught
+        kind = {JobStatus.CANCELLED: "cancelled",
+                JobStatus.EXPIRED: "expired"}.get(status, "failed")
+        self.send(wire.FrameType.ERROR, {
+            "job": job_id,
+            "kind": kind,
+            "type": type(exc).__name__,
+            "message": str(exc),
+        })
+
+    def _on_submit(self, obj: Dict) -> None:
+        job_id = int(obj["job"])
+        if self._draining:
+            self.send(wire.FrameType.ERROR, {
+                "job": job_id, "kind": "failed",
+                "type": "ServiceClosed",
+                "message": f"worker {self._name} is draining",
+            })
+            return
+        try:
+            request = wire.decode_request(obj["request"])
+            handle = self._service.submit(request)
+        except Exception as exc:  # noqa: BLE001 — reported, not handled
+            self.send(wire.FrameType.ERROR, {
+                "job": job_id, "kind": "failed",
+                "type": type(exc).__name__, "message": str(exc),
+            })
+            return
+        watcher = self._make_thread(
+            target=self._watch, args=(job_id, handle),
+            name=f"procs.worker.watch-{job_id}", daemon=True,
+        )
+        watcher.start()
+
+    def _on_ping(self) -> None:
+        self.send(wire.FrameType.PONG, {
+            "worker": self._name,
+            "outstanding": self._service.outstanding(),
+            "slots": self._slots,
+        })
+
+    # -- main loop -----------------------------------------------------
+
+    def serve(self) -> None:
+        from waffle_con_tpu.obs import flight as obs_flight
+
+        self.send(wire.FrameType.HELLO, {
+            "worker": self._name, "pid": os.getpid(), "slots": self._slots,
+        })
+        obs_flight.add_trigger_listener(self.on_trigger)
+        try:
+            while True:
+                try:
+                    data = self._sock.recv(RECV_CHUNK)
+                except OSError:
+                    return
+                if not data:
+                    return  # door closed/died: exit with it
+                for ftype, obj in self._decoder.feed(data):
+                    if ftype is wire.FrameType.SUBMIT:
+                        self._on_submit(obj)
+                    elif ftype is wire.FrameType.PING:
+                        self._on_ping()
+                    elif ftype is wire.FrameType.DRAIN:
+                        self._draining = True
+                    elif ftype is wire.FrameType.SHUTDOWN:
+                        return
+                    # anything else from the door is ignored, not fatal
+        finally:
+            obs_flight.remove_trigger_listener(self.on_trigger)
+            self._service.close(cancel_pending=True, timeout=10.0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="waffle_con_tpu out-of-process serving worker"
+    )
+    parser.add_argument("--socket", required=True,
+                        help="front door's AF_UNIX socket path")
+    parser.add_argument("--worker", required=True,
+                        help="this worker's name (stats/trace label)")
+    parser.add_argument("--spec", default="{}",
+                        help="JSON ServeConfig field overrides")
+    args = parser.parse_args(argv)
+
+    spec = json.loads(args.spec)
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.socket)
+    try:
+        _Worker(sock, args.worker, spec).serve()
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

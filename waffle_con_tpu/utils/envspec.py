@@ -153,6 +153,19 @@ KNOBS: Dict[str, EnvKnob] = dict((
        "CI: p95 ceiling with one demoted (shedding) replica, seconds"),
     _k("WAFFLE_SUITE_TIMEOUT", "int", "600",
        "Sharded suite runner per-shard timeout in seconds"),
+    # -- out-of-process serving (serve/procs) -------------------------
+    _k("WAFFLE_PROC_FRAME_MAX", "int", "33554432",
+       "Wire protocol: maximum frame payload size in bytes (32 MiB)"),
+    _k("WAFFLE_PROC_PING_S", "float", "0.5",
+       "Front door: worker ping interval in seconds"),
+    _k("WAFFLE_PROC_LIVENESS_S", "float", "5.0",
+       "Front door: seconds without any worker frame before the "
+       "liveness watchdog declares the worker lost"),
+    _k("WAFFLE_STORM_PROCS_SPEEDUP", "float", "0.25",
+       "CI: storm-procs multi-worker/single-process jobs/s sanity "
+       "floor; the default is the documented 1-core time-slicing "
+       "sanity value (measured 0.34-0.42) -- raise toward 1.5 on "
+       "real multi-core hosts"),
 ))
 
 
