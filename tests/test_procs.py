@@ -489,6 +489,132 @@ def test_admission_rejects_when_full():
         door.close(cancel_pending=True, timeout=2.0)
 
 
+def test_oversized_submit_fails_job_not_router(monkeypatch):
+    # a request whose SUBMIT frame exceeds WAFFLE_PROC_FRAME_MAX must
+    # fail that one job; the (singleton) router thread keeps routing
+    monkeypatch.setenv("WAFFLE_PROC_FRAME_MAX", "4096")
+    fleet = FakeFleet()
+    with _door(fleet) as door:
+        big = JobRequest(kind="single",
+                         reads=(b"A" * 8192, b"A" * 8192),
+                         config=CdwfaConfig())
+        handle = door.submit(big)
+        assert handle.wait(10)
+        assert handle.status is JobStatus.FAILED
+        with pytest.raises(wire.FrameTooLarge):
+            handle.result(timeout=0)
+        # nothing stays assigned and later jobs still route + finish
+        assert all(w["outstanding"] == 0 for w in door.worker_stats())
+        follow_up = door.submit(_request())
+        assert follow_up.result(timeout=10)[0].sequence == b"FAKE"
+
+
+def test_dispatch_send_failure_respects_worker_lost_ownership():
+    # the OSError path requeues only when the job is still assigned;
+    # when a concurrent _worker_lost already popped + requeued it, a
+    # second append would run the job twice
+    from waffle_con_tpu.serve.job import JobHandle
+
+    door = ProcFrontDoor(
+        ProcConfig(workers=1, launcher=lambda *a: None), autostart=False
+    )
+    try:
+        worker = door._workers[0]
+        a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        a.close()
+        b.close()
+        worker.sock = a  # every sendall raises OSError
+        handle = JobHandle(0, _request(), service="fake")
+        worker.assigned[0] = handle
+        assert door._dispatch(worker, handle) is False
+        assert list(door._retry) == [handle]  # still owned: requeued
+        assert 0 not in worker.assigned
+        door._retry.clear()
+        assert door._dispatch(worker, handle) is False
+        assert not door._retry  # already taken by _worker_lost: not ours
+    finally:
+        door.close(timeout=0.1)
+
+
+def test_worker_unencodable_result_settles_as_error(monkeypatch):
+    # worker side: a DONE job whose result cannot be framed (NaN score
+    # under allow_nan=False) must still send ERROR, never go silent
+    from waffle_con_tpu.analysis import lockcheck
+    from waffle_con_tpu.serve.procs.worker import _Worker as ProcWorker
+
+    side_a, side_b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        worker = ProcWorker.__new__(ProcWorker)
+        worker._sock = side_a
+        worker._name = "t"
+        worker._send_lock = lockcheck.make_lock("test.procs.worker.send")
+
+        class DoneHandle:
+            status = JobStatus.DONE
+            started_at = 1.0
+            request = _request()
+
+            def wait_running(self, timeout=None):
+                return True
+
+            def wait(self, timeout=None):
+                return True
+
+            def result(self, timeout=None):
+                return [Consensus(b"ACGT", ConsensusCost.L1_DISTANCE,
+                                  [float("nan")])]
+
+        worker._watch(7, DoneHandle())
+        side_b.settimeout(5)
+        decoder = wire.FrameDecoder()
+        frames = []
+        while len(frames) < 2:
+            frames.extend(decoder.feed(side_b.recv(65536)))
+        kinds = [ftype for ftype, _ in frames]
+        assert kinds == [wire.FrameType.STARTED, wire.FrameType.ERROR]
+        obj = frames[-1][1]
+        assert obj["job"] == 7 and obj["kind"] == "failed"
+        assert "wire-encodable" in obj["message"]
+    finally:
+        side_a.close()
+        side_b.close()
+
+
+def test_handshake_timeout_reaps_spawned_workers():
+    # start() raising must not leak the worker processes it launched
+
+    class DeadProc:
+        def __init__(self):
+            self.terminated = False
+
+        def poll(self):
+            return None
+
+        def terminate(self):
+            self.terminated = True
+
+        def wait(self, timeout=None):
+            if not self.terminated:
+                raise RuntimeError("still alive")
+            return 0
+
+        def kill(self):
+            self.terminated = True
+
+    procs = []
+
+    def launcher(socket_path, name, spec):
+        proc = DeadProc()
+        procs.append(proc)
+        return proc  # never connects: the handshake must time out
+
+    with pytest.raises(RuntimeError, match="handshake timed out"):
+        ProcFrontDoor(ProcConfig(workers=2, launcher=launcher,
+                                 spawn_timeout_s=0.2))
+    assert len(procs) == 2
+    assert all(p.terminated for p in procs)
+
+
 def test_heartbeats_ledger():
     clock = [0.0]
     beats = Heartbeats(clock=lambda: clock[0])

@@ -268,29 +268,41 @@ class ProcFrontDoor:
             worker.proc = launcher(self._socket_path, worker.name, spec)
         deadline = time.monotonic() + self.config.spawn_timeout_s
         pending = {w.name: w for w in self._workers}
-        while pending:
-            self._listener.settimeout(
-                max(0.1, deadline - time.monotonic())
-            )
-            try:
-                conn, _ = self._listener.accept()
-            except (socket.timeout, OSError):
-                raise RuntimeError(
-                    f"worker handshake timed out; still waiting for "
-                    f"{sorted(pending)}"
-                ) from None
-            hello, trailing, decoder = self._handshake(conn, deadline)
-            worker = pending.pop(hello["worker"], None)
-            if worker is None:
-                conn.close()
-                continue
-            worker.sock = conn
-            worker.decoder = decoder
-            worker.pid = int(hello.get("pid", 0)) or None
-            worker.slots = int(hello.get("slots", 1))
-            self._beats.beat(worker.name)
-            for ftype, obj in trailing:
-                self._on_frame(worker, ftype, obj)
+        conn: Optional[socket.socket] = None
+        try:
+            while pending:
+                self._listener.settimeout(
+                    max(0.1, deadline - time.monotonic())
+                )
+                try:
+                    conn, _ = self._listener.accept()
+                except (socket.timeout, OSError):
+                    raise RuntimeError(
+                        f"worker handshake timed out; still waiting for "
+                        f"{sorted(pending)}"
+                    ) from None
+                hello, trailing, decoder = self._handshake(conn, deadline)
+                worker = pending.pop(hello["worker"], None)
+                if worker is None:
+                    conn.close()
+                    conn = None
+                    continue
+                worker.sock = conn
+                conn = None
+                worker.decoder = decoder
+                worker.pid = int(hello.get("pid", 0)) or None
+                worker.slots = int(hello.get("slots", 1))
+                self._beats.beat(worker.name)
+                for ftype, obj in trailing:
+                    self._on_frame(worker, ftype, obj)
+        except Exception:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._abort_spawn()
+            raise
         for worker in self._workers:
             thread = lockcheck.make_thread(
                 target=self._read_loop, args=(worker,),
@@ -313,6 +325,37 @@ class ProcFrontDoor:
             "procs_door_up", service=self.config.name,
             workers=len(self._workers),
         )
+
+    def _abort_spawn(self) -> None:
+        """Handshake failed: close accepted sockets and reap every
+        process already launched, so a raising :meth:`start` leaks no
+        live workers (each may be mid jax import)."""
+        for worker in self._workers:
+            if worker.sock is not None:
+                try:
+                    worker.sock.close()
+                except OSError:
+                    pass
+                worker.sock = None
+            proc = worker.proc
+            if proc is None:
+                continue
+            try:
+                proc.terminate()
+                proc.wait(timeout=2.0)
+            except Exception:  # noqa: BLE001 - escalate to kill
+                try:
+                    proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+        # a raising start() means close() will never run: drop the
+        # listener + socket dir here or they leak with the object
+        try:
+            self._listener.close()
+            os.unlink(self._socket_path)
+            os.rmdir(self._tmpdir)
+        except OSError:
+            pass
 
     @staticmethod
     def _handshake(conn: socket.socket, deadline: float):
@@ -359,10 +402,10 @@ class ProcFrontDoor:
         budget = timeout if timeout is not None else 60.0
         deadline = time.monotonic() + budget
         while time.monotonic() < deadline:
-            with self._lock:
-                inflight = any(w.assigned for w in self._workers)
-                pending = bool(self._retry) or self._queue.depth() > 0
-            if not inflight and not pending:
+            # outstanding() counts every admitted non-done handle, so a
+            # job mid-route (popped from the queue, not yet in a
+            # worker's assigned set) still holds the drain open
+            if self.outstanding() == 0:
                 break
             time.sleep(0.02)
         self._stopping = True
@@ -492,7 +535,13 @@ class ProcFrontDoor:
                 continue
             if handle.done():
                 continue  # cancelled while queued
-            self._route_one(handle)
+            try:
+                self._route_one(handle)
+            except Exception as exc:  # noqa: BLE001 - the router is a
+                # singleton: an escaping exception would stop routing
+                # forever, so settle the one job and keep going
+                if not handle.done():
+                    handle._finish(JobStatus.FAILED, exception=exc)
 
     def _route_one(self, handle: JobHandle) -> None:
         """Assign one job to the best worker, holding it while no
@@ -556,19 +605,30 @@ class ProcFrontDoor:
                     ),
                 )
                 return False
-        frame = wire.encode_frame(wire.FrameType.SUBMIT, {
-            "job": handle.job_id,
-            "request": wire.encode_request(
-                handle.request, deadline_left_s=deadline_left
-            ),
-        })
+        try:
+            frame = wire.encode_frame(wire.FrameType.SUBMIT, {
+                "job": handle.job_id,
+                "request": wire.encode_request(
+                    handle.request, deadline_left_s=deadline_left
+                ),
+            })
+        except (wire.WireError, ValueError, TypeError) as exc:
+            # an unencodable request (oversized, non-finite, …) must
+            # fail this one job, never the router thread
+            with self._lock:
+                worker.assigned.pop(handle.job_id, None)
+            handle._finish(JobStatus.FAILED, exception=exc)
+            return False
         try:
             with worker.send_lock:
                 worker.sock.sendall(frame)
             return True
         except OSError:
             with self._lock:
-                worker.assigned.pop(handle.job_id, None)
+                if worker.assigned.pop(handle.job_id, None) is None:
+                    # a concurrent _worker_lost already snapshotted and
+                    # requeued this job — it owns the retry
+                    return False
                 self._retry.append(handle)
             return False
 

@@ -120,13 +120,25 @@ class _Worker:
         handle.wait()
         status = handle.status
         if status is JobStatus.DONE:
-            self.send(wire.FrameType.RESULT, {
-                "job": job_id,
-                "kind": handle.request.kind,
-                "result": wire.encode_result(
-                    handle.request.kind, handle.result(timeout=0)
-                ),
-            })
+            try:
+                self.send(wire.FrameType.RESULT, {
+                    "job": job_id,
+                    "kind": handle.request.kind,
+                    "result": wire.encode_result(
+                        handle.request.kind, handle.result(timeout=0)
+                    ),
+                })
+            except Exception as exc:  # noqa: BLE001 - an unencodable
+                # result (oversized frame, NaN score, …) must still
+                # settle the door-side handle, so report it as a
+                # failure instead of dying with neither RESULT nor
+                # ERROR ever sent
+                self.send(wire.FrameType.ERROR, {
+                    "job": job_id,
+                    "kind": "failed",
+                    "type": type(exc).__name__,
+                    "message": f"result not wire-encodable: {exc}",
+                })
             return
         try:
             handle.result(timeout=0)
