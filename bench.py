@@ -319,9 +319,11 @@ def _emit(out, perfdb_kind=None):
         if isinstance(breakdown, dict) and "run_cols" in breakdown:
             rec["run_cols"] = breakdown["run_cols"]
         # tie-heavy records carry their headline companions so the
-        # trend table tells the whole story from one line
+        # trend table tells the whole story from one line; crash-drill
+        # records carry their migration accounting the same way
         for k in ("wall_s", "steps_per_s", "gang_occupancy",
-                  "gang_commit_rate"):
+                  "gang_commit_rate", "migrated", "restarted_started",
+                  "wasted_work_s", "migration_jobs"):
             v = out.get(k)
             if v is None and isinstance(breakdown, dict):
                 v = breakdown.get(k)
@@ -1425,10 +1427,16 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
     ``kill_worker=True`` is the crash drill: during the (single) timed
     multi-worker pass the busiest worker is SIGKILLed after a third of
     the jobs have been submitted.  The front door must detect the dead
-    socket, requeue/restart the victim's jobs on the survivors, and
-    still finish with parity true and exactly one ``worker_lost``
-    flight incident — such runs measure degraded-mode behaviour and
-    never append a perfdb record."""
+    socket, **migrate** the victim's started jobs from their last
+    checkpoints (a dense ``WAFFLE_CKPT_INTERVAL_S`` is pinned for the
+    drill), requeue the rest, and still finish with parity true and
+    exactly one ``worker_lost`` flight incident.  The evidence line
+    carries the migration accounting — ``migrated`` vs
+    ``restarted_started`` counts, ``wasted_work_s`` (work lost between
+    the last snapshot and the crash), and per-migrated-job post-kill
+    wall vs from-scratch wall — and lands as its own
+    ``storm-procs-ckpt`` perfdb kind, so crash drills never join the
+    ``storm-procs`` trend baseline."""
     import signal
 
     from waffle_con_tpu.obs import flight as obs_flight
@@ -1443,11 +1451,44 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
     (shapes, priorities, jobs, offsets, arrival_span,
      large_threshold) = _storm_mix(num_jobs, error_rate, False)
 
-    # in-process serial references (also warms the door-side jax import)
-    serial = [
-        _make_engine("single", base_cfg, reads).consensus()
-        for reads, base_cfg, _serve_cfg in jobs
-    ]
+    anchor_idx = None
+    if kill_worker:
+        # dense snapshots for the drill: the default 30 s cadence would
+        # outlive the whole run, leaving nothing to migrate from
+        os.environ.setdefault("WAFFLE_CKPT_INTERVAL_S", "0.05")
+        # the drill anchor: one deliberately long search, submitted
+        # first, that is still mid-flight (checkpoints streaming) when
+        # the SIGKILL fires.  The storm's own Pareto mix is too
+        # short-lived to guarantee a checkpointed victim job, let
+        # alone a measurable resumed-vs-scratch wall gap.
+        from waffle_con_tpu import CdwfaConfigBuilder
+        from waffle_con_tpu.utils.example_gen import generate_test
+
+        a_reads, a_len, a_err = 10, 400, 0.025
+        anchor_reads = tuple(
+            generate_test(4, a_len, a_reads, a_err, seed=77)[1]
+        )
+        anchor_cfg = (
+            CdwfaConfigBuilder()
+            .min_count(max(2, a_reads // 4))
+            .backend("jax")
+            .initial_band(_band_seed(a_len, a_err))
+            .build()
+        )
+        shapes.insert(0, (a_reads, a_len))
+        priorities.insert(0, 2)
+        jobs.insert(0, (anchor_reads, anchor_cfg, anchor_cfg))
+        offsets.insert(0, 0.0)
+        anchor_idx = 0
+
+    # in-process serial references (also warms the door-side jax
+    # import); per-job walls feed the migration accounting below
+    serial = []
+    serial_walls = []
+    for reads, base_cfg, _serve_cfg in jobs:
+        t_ref = time.perf_counter()
+        serial.append(_make_engine("single", base_cfg, reads).consensus())
+        serial_walls.append(time.perf_counter() - t_ref)
 
     policy = PlacementPolicy(large_read_threshold=large_threshold,
                              mesh_shards=2)
@@ -1464,6 +1505,7 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         ))
         timed_passes = 1 if kill else 2
         best, parity_ok, killed = None, True, None
+        kill_mono, kill_handles, warm_lats = None, None, None
         try:
             for _attempt in range(1 + timed_passes):
                 reqs = [
@@ -1478,15 +1520,57 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
                     if lag > 0:
                         time.sleep(lag)
                     handles.append(door.submit(req))
-                    if (kill and _attempt == 1 and killed is None
-                            and n_procs > 1 and idx >= num_jobs // 3):
+                if (kill and _attempt == 1 and killed is None
+                        and n_procs > 1):
+                    # wait until the anchor job is provably deep into
+                    # its search — its streamed checkpoint reports
+                    # ``farthest_consensus`` past 60% of the target
+                    # length — then kill the worker that owns it, so
+                    # the SIGKILL destroys real progress that
+                    # migration then recovers.  Also require every
+                    # other started job on that worker to have
+                    # snapshotted, so the drill migrates everything
+                    # instead of restarting stragglers.
+                    by_id = {h.job_id: h for h in handles}
+                    anchor = handles[anchor_idx]
+                    gate_len = 0.6 * shapes[anchor_idx][1]
+                    victim, poll_t0 = None, time.perf_counter()
+                    while time.perf_counter() - poll_t0 < 120.0:
+                        if anchor.done():
+                            break
+                        ck = anchor.checkpoint or {}
+                        progress = ((ck.get("body") or {})
+                                    .get("state") or {}
+                                    ).get("farthest_consensus", 0)
+                        if (anchor.started_at is not None
+                                and progress >= gate_len):
+                            owner = next(
+                                (w for w in door.worker_stats()
+                                 if anchor.job_id in w["jobs"]
+                                 and w["state"] == "up" and w["pid"]),
+                                None,
+                            )
+                            if owner is not None and all(
+                                h is None or h.done()
+                                or h.started_at is None
+                                or h.checkpoint is not None
+                                for h in (by_id.get(j)
+                                          for j in owner["jobs"])
+                            ):
+                                victim = owner
+                                break
+                        time.sleep(0.01)
+                    if victim is None:  # anchor finished or never
+                        # snapshotted in time: fall back to the
+                        # busiest worker
                         victim = max(
                             (w for w in door.worker_stats()
                              if w["state"] == "up" and w["pid"]),
                             key=lambda w: w["outstanding"],
                         )
-                        os.kill(victim["pid"], signal.SIGKILL)
-                        killed = victim["worker"]
+                    os.kill(victim["pid"], signal.SIGKILL)
+                    killed = victim["worker"]
+                    kill_mono = time.monotonic()
                 results = [h.result() for h in handles]
                 wall = time.perf_counter() - t0
                 lats = sorted(h.latency_s for h in handles)
@@ -1494,19 +1578,27 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
                     r == ref for r, ref in zip(results, serial)
                 )
                 if _attempt == 0:
+                    # the warmup pass runs the same mix through the
+                    # same door uninterrupted: its per-job walls are
+                    # the from-scratch served baseline the kill
+                    # drill's post-kill walls are judged against
+                    warm_lats = [h.latency_s for h in handles]
                     continue
+                if kill:
+                    kill_handles = list(handles)
                 if best is None or wall < best[0]:
                     best = (wall, lats)
             stats = door.stats()
             workers = door.worker_stats()
         finally:
             door.close()
-        return best + (stats, workers, parity_ok, killed)
+        return best + (stats, workers, parity_ok, killed, kill_mono,
+                       kill_handles, warm_lats)
 
     s_wall, _s_lat, _s_stats, _s_workers, s_parity = run_phase(1)[:5]
-    m_wall, m_lat, m_stats, m_workers, m_parity, killed = run_phase(
-        procs, kill=kill_worker
-    )
+    (m_wall, m_lat, m_stats, m_workers, m_parity, killed,
+     kill_mono, kill_handles, warm_lats) = run_phase(procs,
+                                                     kill=kill_worker)
 
     parity = s_parity and m_parity
     p50 = m_lat[len(m_lat) // 2]
@@ -1521,7 +1613,7 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         "metric": f"storm_procs_{num_jobs}jobs_{procs}p_jobs_per_s",
         "value": round(num_jobs / m_wall, 4),
         "unit": "jobs/s",
-        "mode": "storm-procs",
+        "mode": "storm-procs-ckpt" if kill_worker else "storm-procs",
         "jobs": num_jobs,
         "procs": procs,
         "shapes": shapes,
@@ -1542,6 +1634,9 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
             1 for w in m_workers if w["routed"] > 0
         ),
         "requeues": sum(w["requeues"] for w in m_workers),
+        "migrated": sum(w["migrations"] for w in m_workers),
+        "restarted_started": sum(w["restarts"] for w in m_workers),
+        "checkpoints": m_stats.get("checkpoints", {}),
         "worker_lost_incidents": len(lost_incidents),
         "slo": obs_slo.snapshot(),
         "incidents": [
@@ -1552,7 +1647,41 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         "runtime_events": _runtime_events(),
     }
     if kill_worker:
+        from waffle_con_tpu.runtime import events as runtime_events
+
         out["kill_worker"] = killed or True
+        rescued = runtime_events.get_events("worker_jobs_rescued")
+        out["wasted_work_s"] = round(
+            sum(float(ev.get("wasted_s", 0.0)) for ev in rescued), 4
+        )
+        # per-migrated-job accounting: post-kill wall (kill -> finish
+        # on the survivor, resumed from the checkpoint) vs the same
+        # job's from-scratch wall through the same door (the warmup
+        # pass) — the headline migration win.  The serial wall rides
+        # along for reference; it is not comparable (the serving stack
+        # adds per-dispatch batching overhead a serial run never pays).
+        by_id = {h.job_id: (i, h)
+                 for i, h in enumerate(kill_handles or [])}
+        migration_jobs = []
+        for ev in rescued:
+            for jid in ev.get("migrated_jobs", ()):
+                entry = by_id.get(jid)
+                if entry is None or kill_mono is None:
+                    continue
+                idx, handle = entry
+                if handle.finished_at is None:
+                    continue
+                migration_jobs.append({
+                    "job": jid,
+                    "post_kill_wall_s": round(
+                        handle.finished_at - kill_mono, 4
+                    ),
+                    "scratch_wall_s": round(
+                        (warm_lats or serial_walls)[idx], 4
+                    ),
+                    "serial_wall_s": round(serial_walls[idx], 4),
+                })
+        out["migration_jobs"] = migration_jobs
     return out
 
 
@@ -2169,10 +2298,11 @@ def main() -> None:
                 kill_worker=args.kill_worker,
             )
             out["device_platform"] = _current_platform()
-            # crash drills measure degraded-mode behaviour — never let
-            # them into the rolling perf baseline
-            _emit(out, perfdb_kind=None if out.get("kill_worker")
-                  else "storm-procs")
+            # crash drills measure degraded-mode behaviour: they land
+            # as their own storm-procs-ckpt kind (migration accounting)
+            # and never join the storm-procs trend baseline
+            _emit(out, perfdb_kind="storm-procs-ckpt"
+                  if out.get("kill_worker") else "storm-procs")
             return
         out = bench_storm(
             args.storm,

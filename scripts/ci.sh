@@ -431,10 +431,12 @@ print(
 PY
 
 echo "== storm-procs crash drill (SIGKILL a worker mid-storm) =="
-# kill the busiest worker a third of the way through the timed pass:
-# the door must detect the dead socket, requeue that worker's jobs to
-# a healthy worker, keep every byte identical to serial, and record
-# exactly one worker_lost flight incident (no kill => no perfdb write)
+# kill a checkpointed worker mid-storm: the door must detect the dead
+# socket, migrate its started jobs to a healthy worker from their last
+# CHECKPOINT frames (no full re-search of a started job), keep every
+# byte identical to serial, and record exactly one worker_lost flight
+# incident.  The kill run writes a storm-procs-ckpt perfdb record so
+# migration walls never pollute the storm-procs trend baseline.
 WAFFLE_LOCKCHECK=1 \
   python bench.py --storm 8 --procs 2 --kill-worker --platform cpu \
   > "$KILL_OUT"
@@ -445,7 +447,7 @@ import sys
 
 with open(sys.argv[1]) as fh:
     evidence = json.loads(fh.read().strip().splitlines()[-1])
-assert evidence.get("mode") == "storm-procs", sorted(evidence)
+assert evidence.get("mode") == "storm-procs-ckpt", sorted(evidence)
 assert evidence.get("kill_worker"), sorted(evidence)  # victim info dict
 assert evidence["parity"] is True, "post-crash results diverged from serial"
 assert evidence["requeues"] >= 1, (
@@ -459,9 +461,23 @@ lost = [w for w in evidence["per_worker"] if w["state"] == "lost"]
 assert len(lost) == 1, evidence["per_worker"]
 survivors = [w for w in evidence["per_worker"] if w["state"] != "lost"]
 assert sum(w["routed"] for w in survivors) >= 1, evidence["per_worker"]
+assert evidence["migrated"] >= 1, (
+    f"SIGKILL produced no checkpoint migration: {evidence['checkpoints']}"
+)
+assert evidence["restarted_started"] == 0, (
+    f"{evidence['restarted_started']} started job(s) lost their "
+    f"checkpoints and re-searched from scratch"
+)
+mig = evidence["migration_jobs"]
+assert mig and any(
+    m["post_kill_wall_s"] < m["scratch_wall_s"] for m in mig
+), f"no migrated job beat its from-scratch served wall: {mig}"
+assert evidence["checkpoints"]["frames"] >= 1, evidence["checkpoints"]
 print(
     f"ci storm-procs crash drill ok: lost={lost[0]['worker']}, "
-    f"requeues={evidence['requeues']}, parity held"
+    f"requeues={evidence['requeues']}, "
+    f"migrated={evidence['migrated']} "
+    f"(wasted {evidence['wasted_work_s']}s), parity held"
 )
 PY
 

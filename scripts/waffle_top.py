@@ -9,7 +9,9 @@ dispatch latency and job wall time), per-backend dispatch latency from
 the metrics snapshot, and the most recent flight-recorder incidents.
 When the payload comes from a :class:`ProcFrontDoor` (out-of-process
 serving) the per-worker table shows pid, health state, outstanding
-jobs, slot occupancy, and requeue/demote/shed counters instead of the
+jobs, slot occupancy, requeue/demote/shed counters, and the
+checkpoint/migration columns (frames + bytes streamed, jobs migrated
+from a checkpoint vs restarted from scratch) instead of the
 in-process replica table.
 
 Usage::
@@ -128,6 +130,7 @@ def render(payload: dict, plain: bool = False) -> str:
         lines.append(
             f"  {'worker':<16} {'pid':>7} {'state':<9} {'outst':>5} "
             f"{'slots':>5} {'occ':>5} {'routed':>6} {'requeue':>7} "
+            f"{'migr':>4} {'rst':>3} {'ckpt':>5} {'ckptKB':>6} "
             f"{'demote':>6} {'shed':>4} {'readmit':>7}"
         )
         for wkr in workers:
@@ -140,6 +143,10 @@ def render(payload: dict, plain: bool = False) -> str:
                 f"{wkr.get('occupancy', 0):>5.2f} "
                 f"{wkr.get('routed', 0):>6} "
                 f"{wkr.get('requeues', 0):>7} "
+                f"{wkr.get('migrations', 0):>4} "
+                f"{wkr.get('restarts', 0):>3} "
+                f"{wkr.get('ckpt_frames', 0):>5} "
+                f"{wkr.get('ckpt_bytes', 0) // 1024:>6} "
                 f"{wkr.get('demotions', 0):>6} "
                 f"{wkr.get('sheds', 0):>4} "
                 f"{wkr.get('readmits', 0):>7}"

@@ -1,0 +1,342 @@
+"""Serializable search checkpoints: snapshot → JSON → resume must be
+byte-identical to the uninterrupted search, on every backend, at any
+pop boundary — including mid-gang (``WAFFLE_FRONTIER_M`` > 1) and
+mid-K-block (``WAFFLE_RUN_COLS`` > 1), because the snapshot stores
+only the node-identity tuples ``(consensus, active, offsets)`` and the
+restore rebuilds branches through the ordinary ``root``/``push``/
+``activate`` dispatch seam.  Corrupt, truncated, version-skewed, or
+wrong-engine payloads must raise typed :class:`CheckpointRejected`
+(the stored priorities double as an integrity check on the rebuilt
+nodes), and the serving layer must degrade a rejected checkpoint to a
+from-scratch search — never a failed or hung job."""
+
+import json
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+    PriorityConsensusDWFA,
+)
+from waffle_con_tpu.models import checkpoint as ckpt_mod
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+# ------------------------------------------------------------ workloads
+
+
+def _single_reads():
+    _, reads = generate_test(4, 100, 8, 0.03, seed=52300)
+    return list(reads)
+
+
+def _dual_reads():
+    # kept small: the dual engine pays per-column dispatch for two
+    # consensuses, and the jax matrix runs this at K=1
+    rng = np.random.default_rng(61250)
+    truth, reads1 = generate_test(4, 60, 3, 0.04, seed=61251)
+    h2 = bytearray(truth)
+    for pos in rng.choice(60, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + int(rng.integers(3))) % 4
+    return list(reads1) + [
+        corrupt(bytes(h2), 0.04, np.random.default_rng(61252 + i))
+        for i in range(3)
+    ]
+
+
+def _chains():
+    n = 6
+    _, level0 = generate_test(4, 50, n, 0.02, seed=71000)
+    t1a, _ = generate_test(4, 80, 1, 0.0, seed=71001)
+    t1b = bytearray(t1a)
+    t1b[40] = (t1b[40] + 1) % 4
+    t1b = bytes(t1b)
+    return [
+        [level0[i],
+         corrupt(t1a if i < n // 2 else t1b, 0.02,
+                 np.random.default_rng(71002 + i))]
+        for i in range(n)
+    ]
+
+
+def _cfg(backend, min_count=2):
+    return (
+        CdwfaConfigBuilder().backend(backend).min_count(min_count).build()
+    )
+
+
+def _make_engine(kind, backend):
+    if kind == "single":
+        engine = ConsensusDWFA(_cfg(backend))
+        for read in _single_reads():
+            engine.add_sequence(read)
+    elif kind == "dual":
+        engine = DualConsensusDWFA(_cfg(backend))
+        for read in _dual_reads():
+            engine.add_sequence(read)
+    else:
+        engine = PriorityConsensusDWFA(_cfg(backend))
+        for chain in _chains():
+            engine.add_sequence_chain(chain)
+    return engine
+
+
+def _run_with_snapshots(kind, backend):
+    """Uninterrupted result + every pop-boundary snapshot along the way
+    (interval ~0 => the controller snapshots at every poll)."""
+    snaps = []
+    ctrl = ckpt_mod.CheckpointController(
+        interval_s=1e-9, on_snapshot=snaps.append
+    )
+    with ckpt_mod.installed(ctrl):
+        ref = _make_engine(kind, backend).consensus()
+    assert snaps, "search never reached a snapshot boundary"
+    return ref, snaps
+
+
+# python-oracle runs are M/K-independent and cheap relative to the jax
+# matrix: compute each engine's reference + snapshot set once per module
+_CACHE = {}
+
+
+def _cached_snapshots(kind, backend):
+    if (kind, backend) not in _CACHE:
+        _CACHE[(kind, backend)] = _run_with_snapshots(kind, backend)
+    return _CACHE[(kind, backend)]
+
+
+def _resume(snapshot, extra_reads=()):
+    """The full serialization loop a migration pays: wire dict → JSON
+    text → wire dict → validated checkpoint → primed engine."""
+    wire = json.loads(json.dumps(snapshot.to_wire()))
+    checkpoint = ckpt_mod.SearchCheckpoint.from_wire(wire)
+    return ckpt_mod.resume_engine(checkpoint, extra_reads=extra_reads)
+
+
+# ------------------------------------------------- round-trip parity
+
+
+@pytest.mark.parametrize("kind", ["single", "dual", "priority"])
+def test_python_roundtrip_any_snapshot(kind):
+    """Python oracle: resuming from the first, middle, and last
+    snapshot all finish byte-identical to the uninterrupted search."""
+    ref, snaps = _cached_snapshots(kind, "python")
+    for idx in {0, len(snaps) // 2, len(snaps) - 1}:
+        assert _resume(snaps[idx]).consensus() == ref, (
+            f"{kind} resume from snapshot {idx}/{len(snaps)} diverged"
+        )
+
+
+@pytest.mark.parametrize("kind", ["single", "dual", "priority"])
+@pytest.mark.parametrize("m", [1, 4])
+@pytest.mark.parametrize("k", [1, 4])
+def test_jax_roundtrip_mid_gang_mid_kblock(kind, m, k, monkeypatch):
+    """Device backend: a mid-search snapshot taken while frontier gangs
+    (M=4) and speculative K-blocks (K=4) are in flight resumes
+    byte-identically — speculation is a pure cache, so it never leaks
+    into (or out of) a checkpoint."""
+    monkeypatch.setenv("WAFFLE_FRONTIER_M", str(m))
+    monkeypatch.setenv("WAFFLE_RUN_COLS", str(k))
+    ref = _cached_snapshots(kind, "python")[0]
+    _jax_ref, snaps = _run_with_snapshots(kind, "jax")
+    assert _jax_ref == ref, "jax diverged from the python oracle"
+    assert _resume(snaps[len(snaps) // 2]).consensus() == ref
+
+
+@pytest.mark.parametrize("kind", ["single", "dual", "priority"])
+def test_empty_extra_reads_is_plain_resume(kind):
+    ref, snaps = _cached_snapshots(kind, "python")
+    assert _resume(snaps[len(snaps) // 2], extra_reads=[]).consensus() \
+        == ref
+
+
+# ------------------------------------------------- incremental reads
+
+
+def test_single_incremental_read_joins_mid_search():
+    truth, _ = generate_test(4, 100, 8, 0.03, seed=52300)
+    late = corrupt(truth, 0.03, np.random.default_rng(999))
+    _ref, snaps = _cached_snapshots("single", "python")
+    engine = _resume(snaps[len(snaps) // 2], extra_reads=[late])
+    assert len(engine.sequences) == 9
+    result = engine.consensus()
+    assert result and all(len(c.sequence) > 0 for c in result)
+    # the widened read set is scored: every result carries one score
+    # per read, including the late one
+    assert all(len(c.scores) == 9 for c in result)
+
+
+def test_dual_extra_reads_pop0_only():
+    _ref, snaps = _cached_snapshots("dual", "python")
+    truth, _ = generate_test(4, 60, 3, 0.04, seed=61251)
+    late = corrupt(truth, 0.04, np.random.default_rng(998))
+    pops = [int(s.body["state"]["pops"]) for s in snaps]
+    late_snaps = [s for s, p in zip(snaps, pops) if p > 0]
+    assert late_snaps, "dual search produced no post-pop snapshot"
+    with pytest.raises(ckpt_mod.CheckpointRejected, match="pop-0"):
+        _resume(late_snaps[-1], extra_reads=[late])
+    pop0 = [s for s, p in zip(snaps, pops) if p == 0]
+    if pop0:  # the first poll may already sit past pop 0
+        engine = _resume(pop0[0], extra_reads=[late])
+        assert len(engine.sequences) == len(_dual_reads()) + 1
+        assert engine.consensus() is not None
+
+
+def test_priority_rejects_extra_reads():
+    _ref, snaps = _cached_snapshots("priority", "python")
+    with pytest.raises(ckpt_mod.CheckpointRejected, match="extra_reads"):
+        _resume(snaps[0], extra_reads=[b"\x00\x01"])
+
+
+# ------------------------------------------------- rejection paths
+
+
+def _one_wire_snapshot():
+    """A deep copy — several rejection tests tamper with it in place."""
+    _ref, snaps = _cached_snapshots("single", "python")
+    return json.loads(json.dumps(snaps[len(snaps) // 2].to_wire()))
+
+
+def test_version_skew_rejected():
+    wire = _one_wire_snapshot()
+    wire["version"] = ckpt_mod.CKPT_VERSION + 1
+    with pytest.raises(ckpt_mod.CheckpointRejected, match="version"):
+        ckpt_mod.SearchCheckpoint.from_wire(wire)
+
+
+def test_tampered_body_fails_crc():
+    wire = _one_wire_snapshot()
+    wire["body"]["state"]["pops"] = int(wire["body"]["state"]["pops"]) + 1
+    with pytest.raises(ckpt_mod.CheckpointRejected):
+        ckpt_mod.SearchCheckpoint.from_wire(wire)
+
+
+def test_truncated_body_rejected():
+    wire = _one_wire_snapshot()
+    body = dict(wire["body"])
+    del body["state"]
+    truncated = ckpt_mod.SearchCheckpoint("single", body).to_wire()
+    with pytest.raises(ckpt_mod.CheckpointRejected, match="malformed"):
+        ckpt_mod.resume_engine(
+            ckpt_mod.SearchCheckpoint.from_wire(truncated)
+        )
+
+
+def test_wrong_engine_kind_rejected():
+    wire = _one_wire_snapshot()
+    with pytest.raises(ckpt_mod.CheckpointRejected, match="cannot resume"):
+        DualConsensusDWFA.resume(wire)
+
+
+def test_corrupted_read_rejected_by_priority_check():
+    """Read corruption that survives the CRC (payload re-signed by an
+    attacker or corrupted pre-encode) still cannot poison the search:
+    the rebuilt nodes' priorities disagree with the stored ones and the
+    restore rejects at consume time.  (Every base is rotated — a lone
+    bit-flip past the searched frontier is invisible by design, the
+    restored prefix genuinely doesn't depend on it.)"""
+    wire = _one_wire_snapshot()
+    body = json.loads(json.dumps(wire["body"]))
+    read0 = bytes(ckpt_mod.unb64(body["reads"][0]))
+    body["reads"][0] = ckpt_mod.b64(bytes((b + 1) % 4 for b in read0))
+    resigned = ckpt_mod.SearchCheckpoint("single", body).to_wire()
+    engine = ckpt_mod.resume_engine(
+        ckpt_mod.SearchCheckpoint.from_wire(resigned)
+    )
+    with pytest.raises(ckpt_mod.CheckpointRejected, match="priority"):
+        engine.consensus()
+
+
+def test_non_dict_payload_rejected():
+    for garbage in (None, 17, "{}", [1, 2], {"version": 1}):
+        with pytest.raises(ckpt_mod.CheckpointRejected):
+            ckpt_mod.SearchCheckpoint.from_wire(garbage)
+
+
+# ------------------------------------------------- serving integration
+
+
+def _serve_request():
+    from waffle_con_tpu.serve.job import JobRequest
+
+    return JobRequest(
+        kind="single", reads=tuple(_single_reads()),
+        config=_cfg("python"),
+    )
+
+
+def test_service_resumes_from_checkpoint():
+    from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+
+    ref, snaps = _cached_snapshots("single", "python")
+    wire = json.loads(json.dumps(snaps[len(snaps) // 2].to_wire()))
+    svc = ConsensusService(
+        ServeConfig(workers=1, name="ckpt-test"), publish_stats=False
+    )
+    try:
+        handle = svc.submit(_serve_request(), checkpoint=wire)
+        assert handle.result(timeout=120) == ref
+        stats = svc.stats()["checkpoints"]
+        assert stats["resumed"] == 1
+        assert stats["rejected"] == 0
+    finally:
+        svc.close()
+
+
+def test_service_degrades_rejected_checkpoint():
+    """A checkpoint whose deferred (consume-time) validation fails must
+    restart the search from scratch — job DONE with the right bytes,
+    one rejected count, zero resumed — never a failed job."""
+    from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+
+    ref, snaps = _cached_snapshots("single", "python")
+    wire = json.loads(json.dumps(snaps[len(snaps) // 2].to_wire()))
+    body = json.loads(json.dumps(wire["body"]))
+    read0 = bytes(ckpt_mod.unb64(body["reads"][0]))
+    body["reads"][0] = ckpt_mod.b64(bytes((b + 1) % 4 for b in read0))
+    poisoned = ckpt_mod.SearchCheckpoint("single", body).to_wire()
+    svc = ConsensusService(
+        ServeConfig(workers=1, name="ckpt-test"), publish_stats=False
+    )
+    try:
+        handle = svc.submit(_serve_request(), checkpoint=poisoned)
+        assert handle.result(timeout=120) == ref
+        stats = svc.stats()["checkpoints"]
+        assert stats["rejected"] == 1
+        assert stats["resumed"] == 0
+        # the stale resume point must not ride into a re-dispatch
+        assert handle.checkpoint is None or handle.checkpoint != poisoned
+    finally:
+        svc.close()
+
+
+def test_expired_job_carries_final_checkpoint():
+    """Deadline persistence: an EXPIRED job's handle holds the final
+    snapshot, and resuming it (fresh budget) finishes byte-identical
+    to the uninterrupted search."""
+    from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
+    from waffle_con_tpu.serve.job import JobRequest, JobStatus
+    from waffle_con_tpu.serve.service import ConsensusService, ServeConfig
+
+    ref = _cached_snapshots("single", "python")[0]
+    svc = ConsensusService(
+        ServeConfig(workers=1, name="ckpt-test"), publish_stats=False
+    )
+    try:
+        handle = svc.submit(JobRequest(
+            kind="single", reads=tuple(_single_reads()),
+            config=_cfg("python"), deadline_s=0.001,
+        ))
+        with pytest.raises(DeadlineExceeded):
+            handle.result(timeout=120)
+        assert handle.status is JobStatus.EXPIRED
+        if handle.checkpoint is None:
+            pytest.skip("deadline lapsed before the first pop boundary")
+        engine = ckpt_mod.resume_engine(
+            ckpt_mod.SearchCheckpoint.from_wire(handle.checkpoint)
+        )
+        assert engine.consensus() == ref
+    finally:
+        svc.close()

@@ -30,6 +30,7 @@ from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
+from waffle_con_tpu.models import checkpoint as ckpt_mod
 from waffle_con_tpu.models.frontier import FrontierSpeculator, GangMember
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
@@ -362,6 +363,28 @@ class _Node:
         return (-self.total_cost(cost), len(self.consensus))
 
 
+def _replay_consensus(scorer, specs) -> None:
+    """Advance freshly rooted branches to their nodes' consensuses by
+    replaying every column through the ordinary ``push`` seam, batched
+    across nodes per column.
+
+    Device backends keep a branch-internal consensus buffer that
+    ``activate`` replays when catching a late read's wavefront up — a
+    fresh root's buffer is empty, so a checkpoint restore must fill it
+    *before* activating the node's reads or the catch-up is a no-op
+    and every rebuilt wavefront scores zero.  No reads are tracked
+    during the replay, so the pushes only extend the buffer; the
+    subsequent ``activate`` catch-up then walks the same per-column
+    step the live search used, which keeps the rebuild bit-identical
+    on every backend.  ``specs`` is ``[(handle, consensus), ...]``."""
+    longest = max((len(consensus) for _h, consensus in specs), default=0)
+    for col in range(longest):
+        scorer.push_many([
+            (handle, consensus[: col + 1])
+            for handle, consensus in specs if len(consensus) > col
+        ])
+
+
 class ConsensusDWFA:
     """Generates the single best consensus (or the tied set) for the added
     sequences."""
@@ -410,6 +433,8 @@ class ConsensusDWFA:
         """Search skeleton parity: ``/root/reference/src/consensus.rs:139-351``."""
         cfg = self.config
         cost = cfg.consensus_cost
+        restore = getattr(self, "_restore_state", None)
+        self._restore_state = None
         maximum_error = math.inf
         nodes_explored = 0
         nodes_ignored = 0
@@ -443,24 +468,51 @@ class ConsensusDWFA:
         )
         pqueue = SetPriorityQueue()
 
-        active = [o is None for o in offsets]
-        root_handle = scorer.root(np.array(active, dtype=bool))
-        root = _Node(
-            b"",
-            root_handle,
-            active,
-            [0 if a else None for a in active],
-            scorer.stats(root_handle, b""),
-        )
-        tracker.insert(0)
-        pqueue.push(root.key(), root, root.priority(cost))
-
         results: List[Consensus] = []
         pops = 0
+        if restore is None:
+            active = [o is None for o in offsets]
+            root_handle = scorer.root(np.array(active, dtype=bool))
+            root = _Node(
+                b"",
+                root_handle,
+                active,
+                [0 if a else None for a in active],
+                scorer.stats(root_handle, b""),
+            )
+            tracker.insert(0)
+            pqueue.push(root.key(), root, root.priority(cost))
+        else:
+            (maximum_error, nodes_explored, nodes_ignored, peak_queue_size,
+             farthest_consensus, last_constraint, pops, results) = (
+                self._restore_search(restore, scorer, pqueue, tracker, cost)
+            )
         frontier = FrontierSampler("single")
         speculator = FrontierSpeculator(scorer, cfg)
 
+        ctrl = ckpt_mod.current_controller()
+
+        def _ckpt_body() -> Dict:
+            # a closure over the loop locals: reads their values at
+            # snapshot time, always at the top-of-pop-loop boundary
+            return self._checkpoint_body(
+                pqueue, tracker,
+                maximum_error=maximum_error,
+                nodes_explored=nodes_explored,
+                nodes_ignored=nodes_ignored,
+                peak_queue_size=peak_queue_size,
+                farthest_consensus=farthest_consensus,
+                last_constraint=last_constraint,
+                pops=pops,
+                results=results,
+            )
+
         while not pqueue.is_empty():
+            if ctrl is not None:
+                try:
+                    ctrl.poll(pops, _ckpt_body)
+                finally:
+                    self._last_checkpoint = ctrl.last_checkpoint
             peak_queue_size = max(peak_queue_size, len(pqueue))
 
             while (
@@ -806,6 +858,193 @@ class ConsensusDWFA:
             cfg, self.last_search_stats["scorer_counters"], "single"
         )
         return results
+
+    # -- checkpoint / resume -------------------------------------------
+
+    def snapshot(self) -> Optional["ckpt_mod.SearchCheckpoint"]:
+        """The most recent :class:`SearchCheckpoint` built for this
+        engine's search (by the installed
+        :class:`~waffle_con_tpu.models.checkpoint.CheckpointController`),
+        or ``None`` — survives a preempted/expired search."""
+        return getattr(self, "_last_checkpoint", None)
+
+    def _checkpoint_body(
+        self, pqueue, tracker, *, maximum_error, nodes_explored,
+        nodes_ignored, peak_queue_size, farthest_consensus,
+        last_constraint, pops, results,
+    ) -> Dict:
+        """JSON checkpoint body at a pop boundary.  Only host-level node
+        identity travels (consensus bytes, active sets, offsets) — never
+        scorer handles or wavefront arrays; prefetch caches and
+        frontier-gang deposits are deliberately absent (dropping them is
+        byte-safe: they are pure caches / consume-once speculation)."""
+        entries = []
+        for _key, nd, pri, seq in pqueue.export_entries():
+            entries.append({
+                "consensus": ckpt_mod.b64(nd.consensus),
+                "active": [1 if a else 0 for a in nd.active],
+                "offsets": [o if o is None else int(o)
+                            for o in nd.offsets],
+                "priority": [int(p) for p in pri],
+                "seq": int(seq),
+            })
+        return {
+            "kind": "single",
+            "config": ckpt_mod.encode_config_dict(self.config),
+            "reads": [ckpt_mod.b64(s) for s in self.sequences],
+            "offsets": [o if o is None else int(o) for o in self.offsets],
+            "state": {
+                "entries": entries,
+                "queue_seq": pqueue.export_seq(),
+                "tracker": tracker.export_state(),
+                "maximum_error": (None if maximum_error == math.inf
+                                  else int(maximum_error)),
+                "nodes_explored": int(nodes_explored),
+                "nodes_ignored": int(nodes_ignored),
+                "peak_queue_size": int(peak_queue_size),
+                "farthest_consensus": int(farthest_consensus),
+                "last_constraint": int(last_constraint),
+                "pops": int(pops),
+                "results": [
+                    {"sequence": ckpt_mod.b64(c.sequence),
+                     "scores": [int(s) for s in c.scores]}
+                    for c in results
+                ],
+            },
+        }
+
+    def _restore_search(self, restore, scorer, pqueue, tracker, cost):
+        """Rebuild the mid-search state captured by
+        :meth:`_checkpoint_body` and return the loop-local tuple.
+
+        Each branch is rebuilt through the ordinary dispatch seam —
+        fresh ``root``, the node's consensus replayed column-by-column
+        through ``push`` (see :func:`_replay_consensus`), then one
+        ``activate`` per active read — which is bit-identical on any
+        backend because active wavefront state is a deterministic
+        function of ``(read, consensus, offset)`` and ``activate``'s
+        catch-up walks the same per-column step the live search used
+        (late activation behind the frontier is an ordinary mid-search
+        event).  The stored priorities double as an integrity check: a
+        rebuilt node whose priority disagrees with the checkpoint means
+        the checkpoint does not belong to these reads/config, and the
+        restore is rejected rather than silently corrupting the
+        search."""
+        st = restore["state"]
+        cost_local = cost
+        extra = int(restore.get("extra", 0))
+        n_total = len(self.sequences)
+        n_base = n_total - extra
+        try:
+            if not extra:
+                tracker.restore_state(st["tracker"])
+            results = [
+                Consensus(ckpt_mod.unb64(r["sequence"]), cost_local,
+                          [int(s) for s in r["scores"]])
+                for r in st["results"]
+            ]
+            maximum_error = (math.inf if st["maximum_error"] is None
+                             else int(st["maximum_error"]))
+            staged = []
+            for entry in st["entries"]:
+                consensus = ckpt_mod.unb64(entry["consensus"])
+                active = [bool(a) for a in entry["active"]]
+                offs = [o if o is None else int(o)
+                        for o in entry["offsets"]]
+                if len(active) != n_base or len(offs) != n_base:
+                    raise ckpt_mod.CheckpointRejected(
+                        "node read-count mismatch vs checkpoint reads"
+                    )
+                # incremental reads join every live branch at offset 0
+                active += [True] * extra
+                offs += [0] * extra
+                handle = scorer.root(np.zeros(n_total, dtype=bool))
+                staged.append((entry, consensus, active, offs, handle))
+            _replay_consensus(
+                scorer, [(handle, consensus)
+                         for _e, consensus, _a, _o, handle in staged]
+            )
+            for entry, consensus, active, offs, handle in staged:
+                for read_index, is_active in enumerate(active):
+                    if is_active:
+                        scorer.activate(
+                            handle, read_index, offs[read_index], consensus
+                        )
+                node = _Node(
+                    consensus, handle, active, offs,
+                    scorer.stats(handle, consensus),
+                )
+                prio = node.priority(cost_local)
+                if not extra and tuple(int(p) for p in prio) != tuple(
+                    int(p) for p in entry["priority"]
+                ):
+                    raise ckpt_mod.CheckpointRejected(
+                        "restored node priority mismatch — checkpoint "
+                        "does not match its reads/config"
+                    )
+                if extra:
+                    tracker.insert(len(consensus))
+                pqueue.push_restored(
+                    node.key(), node, prio, int(entry["seq"])
+                )
+            pqueue.restore_seq(int(st["queue_seq"]))
+            if extra:
+                # the wider read set invalidates the accepted results
+                # and the cost bound; the search re-derives both
+                results = []
+                maximum_error = math.inf
+            return (
+                maximum_error,
+                int(st["nodes_explored"]),
+                int(st["nodes_ignored"]),
+                int(st["peak_queue_size"]),
+                int(st["farthest_consensus"]),
+                int(st["last_constraint"]),
+                int(st["pops"]),
+                results,
+            )
+        except ckpt_mod.CheckpointError:
+            raise
+        except (KeyError, TypeError, ValueError, IndexError) as exc:
+            raise ckpt_mod.CheckpointRejected(
+                f"malformed single-engine checkpoint state: {exc}"
+            ) from None
+
+    @classmethod
+    def resume(
+        cls, checkpoint, extra_reads: Sequence[bytes] = ()
+    ) -> "ConsensusDWFA":
+        """An engine primed to continue ``checkpoint`` (a
+        :class:`SearchCheckpoint` or its wire-dict form); run
+        :meth:`consensus` on it to finish the search.  ``extra_reads``
+        join every live branch initially-active at offset 0 —
+        incremental (streaming) resume; with no extras the resumed
+        search is byte-identical to the uninterrupted one."""
+        body = ckpt_mod.resume_body(checkpoint, "single")
+        try:
+            config = ckpt_mod.decode_config_dict(body["config"])
+            reads = [ckpt_mod.unb64(r) for r in body["reads"]]
+            offsets = [o if o is None else int(o)
+                       for o in body["offsets"]]
+            state = body["state"]
+            if not isinstance(state, dict) or len(reads) != len(offsets):
+                raise ckpt_mod.CheckpointRejected(
+                    "malformed single-engine checkpoint body"
+                )
+        except ckpt_mod.CheckpointError:
+            raise
+        except (KeyError, TypeError, ValueError) as exc:
+            raise ckpt_mod.CheckpointRejected(
+                f"malformed single-engine checkpoint body: {exc}"
+            ) from None
+        engine = cls(config)
+        for read, offset in zip(reads, offsets):
+            engine.add_sequence_offset(read, offset)
+        extras = [bytes(r) for r in extra_reads]
+        for read in extras:
+            engine.add_sequence(read)
+        engine._restore_state = {"state": state, "extra": len(extras)}
+        return engine
 
     # ------------------------------------------------------------------
 

@@ -21,12 +21,14 @@ from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
+from waffle_con_tpu.models import checkpoint as ckpt_mod
 from waffle_con_tpu.models.frontier import FrontierSpeculator, GangMember
 from waffle_con_tpu.models.consensus import (
     PROGRESS_LOG_INTERVAL,
     RUN_SIM_CAP,
     Consensus,
     EngineError,
+    _replay_consensus,
     accept_record,
     candidates_from_stats,
     replay_arena_history,
@@ -380,6 +382,8 @@ class DualConsensusDWFA:
         """Parity skeleton: ``/root/reference/src/dual_consensus.rs:240-787``."""
         cfg = self.config
         cost = cfg.consensus_cost
+        restore = getattr(self, "_restore_state", None)
+        self._restore_state = None
         n_seqs = len(self.sequences)
         maximum_error = math.inf
         farthest_single = 0
@@ -422,15 +426,16 @@ class DualConsensusDWFA:
         dual_tracker = PQueueTracker(initial_size, cfg.max_capacity_per_size)
         pqueue = SetPriorityQueue()
 
-        root = _DualNode()
-        root.active1 = [o is None for o in offsets]
-        root.active2 = [False] * n_seqs
-        root.offsets1 = [0 if a else None for a in root.active1]
-        root.offsets2 = [None] * n_seqs
-        root.h1 = scorer.root(np.array(root.active1, dtype=bool))
-        root.stats1 = scorer.stats(root.h1, b"")
-        single_tracker.insert(root.max_consensus_length())
-        pqueue.push(root.key(), root, root.priority(cost))
+        if restore is None:
+            root = _DualNode()
+            root.active1 = [o is None for o in offsets]
+            root.active2 = [False] * n_seqs
+            root.offsets1 = [0 if a else None for a in root.active1]
+            root.offsets2 = [None] * n_seqs
+            root.h1 = scorer.root(np.array(root.active1, dtype=bool))
+            root.stats1 = scorer.stats(root.h1, b"")
+            single_tracker.insert(root.max_consensus_length())
+            pqueue.push(root.key(), root, root.priority(cost))
 
         results: List[DualConsensus] = []
 
@@ -466,9 +471,46 @@ class DualConsensusDWFA:
             )
 
         pops = 0
+        if restore is not None:
+            (maximum_error, farthest_single, farthest_dual,
+             single_last_constraint, dual_last_constraint,
+             nodes_explored, nodes_ignored, peak_queue_size, pops,
+             results, total_active_count, active_min_count) = (
+                self._restore_search(
+                    restore, scorer, pqueue, single_tracker, dual_tracker,
+                    cost, total_active_count, active_min_count,
+                )
+            )
         frontier = FrontierSampler("dual")
         speculator = FrontierSpeculator(scorer, cfg)
+
+        ctrl = ckpt_mod.current_controller()
+
+        def _ckpt_body() -> Dict:
+            # closure over the loop locals: reads their values at
+            # snapshot time, always at the top-of-pop-loop boundary
+            return self._checkpoint_body(
+                pqueue, single_tracker, dual_tracker,
+                maximum_error=maximum_error,
+                farthest_single=farthest_single,
+                farthest_dual=farthest_dual,
+                single_last_constraint=single_last_constraint,
+                dual_last_constraint=dual_last_constraint,
+                nodes_explored=nodes_explored,
+                nodes_ignored=nodes_ignored,
+                peak_queue_size=peak_queue_size,
+                pops=pops,
+                results=results,
+                total_active_count=total_active_count,
+                active_min_count=active_min_count,
+            )
+
         while not pqueue.is_empty():
+            if ctrl is not None:
+                try:
+                    ctrl.poll(pops, _ckpt_body)
+                finally:
+                    self._last_checkpoint = ctrl.last_checkpoint
             peak_queue_size = max(peak_queue_size, len(pqueue))
             while (
                 len(single_tracker) > cfg.max_queue_size
@@ -992,6 +1034,269 @@ class DualConsensusDWFA:
             cfg, self.last_search_stats["scorer_counters"], "dual"
         )
         return results
+
+    # ==================================================================
+    # checkpoint / resume
+
+    def snapshot(self) -> Optional["ckpt_mod.SearchCheckpoint"]:
+        """The most recent :class:`SearchCheckpoint` built for this
+        engine's search (by the installed
+        :class:`~waffle_con_tpu.models.checkpoint.CheckpointController`),
+        or ``None`` — survives a preempted/expired search."""
+        return getattr(self, "_last_checkpoint", None)
+
+    @staticmethod
+    def _encode_dual_result(d: DualConsensus) -> Dict:
+        def enc(c):
+            return None if c is None else {
+                "sequence": ckpt_mod.b64(c.sequence),
+                "scores": [int(s) for s in c.scores],
+            }
+
+        return {
+            "consensus1": enc(d.consensus1),
+            "consensus2": enc(d.consensus2),
+            "is_consensus1": [1 if b else 0 for b in d.is_consensus1],
+            "scores1": [None if s is None else int(s) for s in d.scores1],
+            "scores2": [None if s is None else int(s) for s in d.scores2],
+        }
+
+    @staticmethod
+    def _decode_dual_result(obj: Dict, cost: ConsensusCost) -> DualConsensus:
+        def dec(c):
+            return None if c is None else Consensus(
+                ckpt_mod.unb64(c["sequence"]), cost,
+                [int(s) for s in c["scores"]],
+            )
+
+        return DualConsensus(
+            dec(obj["consensus1"]),
+            dec(obj["consensus2"]),
+            [bool(b) for b in obj["is_consensus1"]],
+            [None if s is None else int(s) for s in obj["scores1"]],
+            [None if s is None else int(s) for s in obj["scores2"]],
+        )
+
+    def _checkpoint_body(
+        self, pqueue, single_tracker, dual_tracker, *, maximum_error,
+        farthest_single, farthest_dual, single_last_constraint,
+        dual_last_constraint, nodes_explored, nodes_ignored,
+        peak_queue_size, pops, results, total_active_count,
+        active_min_count,
+    ) -> Dict:
+        """JSON checkpoint body at a pop boundary (single-engine twin:
+        :meth:`ConsensusDWFA._checkpoint_body`).  Node identity is the
+        host-level tuple per side — consensus bytes, active sets,
+        offsets, split locks; wavefronts rebuild through the dispatch
+        seam on resume.  The ``mc_tab``/``imb_tab`` device tables are
+        pure functions of config + activation schedule and are never
+        serialized."""
+        entries = []
+        for _key, nd, pri, seq in pqueue.export_entries():
+            entries.append({
+                "is_dual": 1 if nd.is_dual else 0,
+                "lock1": 1 if nd.lock1 else 0,
+                "lock2": 1 if nd.lock2 else 0,
+                "consensus1": ckpt_mod.b64(nd.consensus1),
+                "consensus2": ckpt_mod.b64(nd.consensus2),
+                "active1": [1 if a else 0 for a in nd.active1],
+                "active2": [1 if a else 0 for a in nd.active2],
+                "offsets1": [o if o is None else int(o)
+                             for o in nd.offsets1],
+                "offsets2": [o if o is None else int(o)
+                             for o in nd.offsets2],
+                "priority": [int(p) for p in pri],
+                "seq": int(seq),
+            })
+        return {
+            "kind": "dual",
+            "config": ckpt_mod.encode_config_dict(self.config),
+            "reads": [ckpt_mod.b64(s) for s in self.sequences],
+            "offsets": [o if o is None else int(o) for o in self.offsets],
+            "state": {
+                "entries": entries,
+                "queue_seq": pqueue.export_seq(),
+                "single_tracker": single_tracker.export_state(),
+                "dual_tracker": dual_tracker.export_state(),
+                "maximum_error": (None if maximum_error == math.inf
+                                  else int(maximum_error)),
+                "farthest_single": int(farthest_single),
+                "farthest_dual": int(farthest_dual),
+                "single_last_constraint": int(single_last_constraint),
+                "dual_last_constraint": int(dual_last_constraint),
+                "nodes_explored": int(nodes_explored),
+                "nodes_ignored": int(nodes_ignored),
+                "peak_queue_size": int(peak_queue_size),
+                "pops": int(pops),
+                "total_active_count": [int(n) for n in total_active_count],
+                "active_min_count": [int(n) for n in active_min_count],
+                "results": [self._encode_dual_result(d) for d in results],
+            },
+        }
+
+    def _restore_search(
+        self, restore, scorer, pqueue, single_tracker, dual_tracker,
+        cost, total_active_count, active_min_count,
+    ):
+        """Rebuild the mid-search state captured by
+        :meth:`_checkpoint_body`; returns the loop-local tuple.  Each
+        side of each node rebuilds through the dispatch seam — fresh
+        root, the side's consensus replayed through ``push`` (see
+        :func:`~waffle_con_tpu.models.consensus._replay_consensus`:
+        device backends need the branch-internal buffer filled before
+        ``activate`` can catch a wavefront up), then one activate per
+        tracked read — bit-identical on any backend; stored priorities
+        double as the integrity check."""
+        st = restore["state"]
+        extra = int(restore.get("extra", 0))
+        n_total = len(self.sequences)
+        n_base = n_total - extra
+        try:
+            if not extra:
+                single_tracker.restore_state(st["single_tracker"])
+                dual_tracker.restore_state(st["dual_tracker"])
+                total_active_count = [
+                    int(n) for n in st["total_active_count"]
+                ]
+                active_min_count = [
+                    int(n) for n in st["active_min_count"]
+                ]
+            results = [
+                self._decode_dual_result(r, cost) for r in st["results"]
+            ]
+            maximum_error = (math.inf if st["maximum_error"] is None
+                             else int(st["maximum_error"]))
+            staged = []
+            replay_specs = []
+            for entry in st["entries"]:
+                node = _DualNode()
+                node.is_dual = bool(entry["is_dual"])
+                node.lock1 = bool(entry["lock1"])
+                node.lock2 = bool(entry["lock2"])
+                node.consensus1 = ckpt_mod.unb64(entry["consensus1"])
+                node.consensus2 = ckpt_mod.unb64(entry["consensus2"])
+                node.active1 = [bool(a) for a in entry["active1"]]
+                node.active2 = [bool(a) for a in entry["active2"]]
+                node.offsets1 = [o if o is None else int(o)
+                                 for o in entry["offsets1"]]
+                node.offsets2 = [o if o is None else int(o)
+                                 for o in entry["offsets2"]]
+                if (len(node.active1) != n_base
+                        or len(node.active2) != n_base
+                        or len(node.offsets1) != n_base
+                        or len(node.offsets2) != n_base):
+                    raise ckpt_mod.CheckpointRejected(
+                        "node read-count mismatch vs checkpoint reads"
+                    )
+                # incremental reads join side 1 at offset 0 (pop-0 only)
+                node.active1 += [True] * extra
+                node.active2 += [False] * extra
+                node.offsets1 += [0] * extra
+                node.offsets2 += [None] * extra
+                node.h1 = scorer.root(np.zeros(n_total, dtype=bool))
+                replay_specs.append((node.h1, node.consensus1))
+                if node.is_dual:
+                    node.h2 = scorer.root(np.zeros(n_total, dtype=bool))
+                    replay_specs.append((node.h2, node.consensus2))
+                staged.append((entry, node))
+            _replay_consensus(scorer, replay_specs)
+            for entry, node in staged:
+                for r, is_active in enumerate(node.active1):
+                    if is_active:
+                        scorer.activate(
+                            node.h1, r, node.offsets1[r], node.consensus1
+                        )
+                node.stats1 = scorer.stats(node.h1, node.consensus1)
+                if node.is_dual:
+                    for r, is_active in enumerate(node.active2):
+                        if is_active:
+                            scorer.activate(
+                                node.h2, r, node.offsets2[r],
+                                node.consensus2,
+                            )
+                    node.stats2 = scorer.stats(node.h2, node.consensus2)
+                prio = node.priority(cost)
+                if not extra and tuple(int(p) for p in prio) != tuple(
+                    int(p) for p in entry["priority"]
+                ):
+                    raise ckpt_mod.CheckpointRejected(
+                        "restored node priority mismatch — checkpoint "
+                        "does not match its reads/config"
+                    )
+                if extra:
+                    tracker = (dual_tracker if node.is_dual
+                               else single_tracker)
+                    tracker.insert(node.max_consensus_length())
+                pqueue.push_restored(
+                    node.key(), node, prio, int(entry["seq"])
+                )
+            pqueue.restore_seq(int(st["queue_seq"]))
+            if extra:
+                # the wider read set invalidates accepted results and
+                # the cost bound; the search re-derives both
+                results = []
+                maximum_error = math.inf
+            return (
+                maximum_error,
+                int(st["farthest_single"]),
+                int(st["farthest_dual"]),
+                int(st["single_last_constraint"]),
+                int(st["dual_last_constraint"]),
+                int(st["nodes_explored"]),
+                int(st["nodes_ignored"]),
+                int(st["peak_queue_size"]),
+                int(st["pops"]),
+                results,
+                total_active_count,
+                active_min_count,
+            )
+        except ckpt_mod.CheckpointError:
+            raise
+        except (KeyError, TypeError, ValueError, IndexError) as exc:
+            raise ckpt_mod.CheckpointRejected(
+                f"malformed dual-engine checkpoint state: {exc}"
+            ) from None
+
+    @classmethod
+    def resume(
+        cls, checkpoint, extra_reads=()
+    ) -> "DualConsensusDWFA":
+        """An engine primed to continue ``checkpoint`` (a
+        :class:`SearchCheckpoint` or its wire-dict form); run
+        :meth:`consensus` on it to finish the search byte-identically.
+        ``extra_reads`` are only accepted on a pop-0 checkpoint (before
+        any split decisions the new reads never voted on)."""
+        body = ckpt_mod.resume_body(checkpoint, "dual")
+        try:
+            config = ckpt_mod.decode_config_dict(body["config"])
+            reads = [ckpt_mod.unb64(r) for r in body["reads"]]
+            offsets = [o if o is None else int(o)
+                       for o in body["offsets"]]
+            state = body["state"]
+            if not isinstance(state, dict) or len(reads) != len(offsets):
+                raise ckpt_mod.CheckpointRejected(
+                    "malformed dual-engine checkpoint body"
+                )
+        except ckpt_mod.CheckpointError:
+            raise
+        except (KeyError, TypeError, ValueError) as exc:
+            raise ckpt_mod.CheckpointRejected(
+                f"malformed dual-engine checkpoint body: {exc}"
+            ) from None
+        extras = [bytes(r) for r in extra_reads]
+        if extras and int(state.get("pops", -1)) != 0:
+            raise ckpt_mod.CheckpointRejected(
+                "extra_reads require a pop-0 dual checkpoint (later "
+                "snapshots hold split decisions the new reads never "
+                "voted on)"
+            )
+        engine = cls(config)
+        for read, offset in zip(reads, offsets):
+            engine.add_sequence_offset(read, offset)
+        for read in extras:
+            engine.add_sequence(read)
+        engine._restore_state = {"state": state, "extra": len(extras)}
+        return engine
 
     # ==================================================================
     # arena fast path

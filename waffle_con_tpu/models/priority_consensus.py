@@ -12,9 +12,10 @@ chains.  Capability parity with
 from __future__ import annotations
 
 import logging
-from typing import List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.models import checkpoint as ckpt_mod
 from waffle_con_tpu.models.consensus import (
     PROGRESS_LOG_INTERVAL,
     Consensus,
@@ -120,17 +121,22 @@ class PriorityConsensusDWFA:
         return _reported_search(self, "priority", self._consensus_impl)
 
     def _consensus_impl(self) -> PriorityConsensus:
+        restore = getattr(self, "_restore_state", None)
+        self._restore_state = None
         max_split_level = len(self.sequences[0])
         to_split: List[List[bool]] = []
         split_levels: List[int] = []
         consensus_chains: List[List[Consensus]] = []
 
-        # one initial group per distinct seed (deterministic order)
-        initial_group_keys: Set[Optional[int]] = set(self.seed_groups)
-        for igk in sorted(initial_group_keys, key=lambda k: (k is not None, k)):
-            to_split.append([sg == igk for sg in self.seed_groups])
-            split_levels.append(0)
-            consensus_chains.append([])
+        if restore is None:
+            # one initial group per distinct seed (deterministic order)
+            initial_group_keys: Set[Optional[int]] = set(self.seed_groups)
+            for igk in sorted(
+                initial_group_keys, key=lambda k: (k is not None, k)
+            ):
+                to_split.append([sg == igk for sg in self.seed_groups])
+                split_levels.append(0)
+                consensus_chains.append([])
 
         consensuses: List[List[Consensus]] = []
         assignments: List[List[bool]] = []
@@ -151,11 +157,76 @@ class PriorityConsensusDWFA:
         last_backend = None
         share_scorer = self.config.backend == "jax"
         groups_solved = 0
-        while to_split:
-            include_set = to_split.pop()
-            current_split_level = split_levels.pop()
-            current_chain = consensus_chains.pop()
-            groups_solved += 1
+        pending: Optional[Tuple] = None
+        if restore is not None:
+            (to_split, split_levels, consensus_chains, consensuses,
+             assignments, merged_counters, scorer_constructions,
+             total_explored, total_ignored, peak_queue_size,
+             groups_solved, pending) = self._restore_worklist(restore)
+
+        ctrl = ckpt_mod.current_controller()
+        include_set: List[bool] = []
+        current_split_level = 0
+        current_chain: List[Consensus] = []
+
+        def _wrap_body(inner_body: Dict) -> Dict:
+            # a closure over the worklist locals, called by the
+            # controller while the inner dual solve is mid-search: the
+            # popped (in-flight) group travels as ``current`` with the
+            # inner dual state embedded, the rest of the worklist and
+            # the accumulators as-is
+            enc = self._encode_consensus
+            return {
+                "kind": "priority",
+                "config": ckpt_mod.encode_config_dict(self.config),
+                "chains": [[ckpt_mod.b64(s) for s in chain]
+                           for chain in self.sequences],
+                "offsets": [[o if o is None else int(o) for o in chain]
+                            for chain in self.offsets],
+                "seed_groups": [
+                    sg if sg is None else int(sg)
+                    for sg in self.seed_groups
+                ],
+                "state": {
+                    "to_split": [[1 if x else 0 for x in row]
+                                 for row in to_split],
+                    "split_levels": [int(l) for l in split_levels],
+                    "consensus_chains": [[enc(c) for c in chain]
+                                         for chain in consensus_chains],
+                    "consensuses": [[enc(c) for c in chain]
+                                    for chain in consensuses],
+                    "assignments": [[1 if x else 0 for x in row]
+                                    for row in assignments],
+                    "merged_counters": {str(k): int(v) for k, v
+                                        in merged_counters.items()},
+                    "scorer_constructions": int(scorer_constructions),
+                    "total_explored": int(total_explored),
+                    "total_ignored": int(total_ignored),
+                    "peak_queue_size": int(peak_queue_size),
+                    "groups_solved": int(groups_solved),
+                    "current": {
+                        "include_set": [1 if x else 0
+                                        for x in include_set],
+                        "split_level": int(current_split_level),
+                        "chain": [enc(c) for c in current_chain],
+                    },
+                    "inner": inner_body["state"],
+                },
+            }
+
+        while to_split or pending is not None:
+            if pending is not None:
+                # the group in flight when the checkpoint was taken;
+                # groups_solved already counted it at the original pop
+                (include_set, current_split_level, current_chain,
+                 inner_state) = pending
+                pending = None
+            else:
+                include_set = to_split.pop()
+                current_split_level = split_levels.pop()
+                current_chain = consensus_chains.pop()
+                inner_state = None
+                groups_solved += 1
             if groups_solved % PROGRESS_LOG_INTERVAL == 0:
                 logger.debug(
                     "search progress: %d groups solved, worklist=%d, "
@@ -194,7 +265,16 @@ class PriorityConsensusDWFA:
                         offset_chain[current_split_level],
                     )
 
-            dc_result = dc_dwfa.consensus()
+            if inner_state is not None:
+                dc_dwfa._restore_state = {"state": inner_state, "extra": 0}
+            if ctrl is not None:
+                ctrl.push_wrapper(_wrap_body)
+            try:
+                dc_result = dc_dwfa.consensus()
+            finally:
+                if ctrl is not None:
+                    ctrl.pop_wrapper()
+                    self._last_checkpoint = ctrl.last_checkpoint
             inner_stats = dc_dwfa.last_search_stats
             for k, v in inner_stats["scorer_counters"].items():
                 merged_counters[k] = merged_counters.get(k, 0) + v
@@ -283,3 +363,116 @@ class PriorityConsensusDWFA:
                 sorted_cons.append(consensuses[old_index])
             return PriorityConsensus(sorted_cons, indices)
         return PriorityConsensus(consensuses, [0] * len(self.sequences))
+
+    # -- checkpoint / resume -------------------------------------------
+
+    def snapshot(self) -> Optional["ckpt_mod.SearchCheckpoint"]:
+        """The most recent :class:`SearchCheckpoint` built for this
+        engine's search (by the installed
+        :class:`~waffle_con_tpu.models.checkpoint.CheckpointController`),
+        or ``None`` — survives a preempted/expired search."""
+        return getattr(self, "_last_checkpoint", None)
+
+    @staticmethod
+    def _encode_consensus(c: Consensus) -> Dict:
+        return {
+            "sequence": ckpt_mod.b64(c.sequence),
+            "scores": [int(s) for s in c.scores],
+        }
+
+    def _decode_consensus(self, obj: Dict) -> Consensus:
+        return Consensus(
+            ckpt_mod.unb64(obj["sequence"]),
+            self.config.consensus_cost,
+            [int(s) for s in obj["scores"]],
+        )
+
+    def _restore_worklist(self, restore):
+        """Rebuild the worklist state captured by the checkpoint
+        wrapper in :meth:`_consensus_impl`; the in-flight group comes
+        back as ``pending`` with its embedded inner dual state, which
+        the loop re-enters through
+        :meth:`DualConsensusDWFA._restore_search`."""
+        st = restore["state"]
+        dec = self._decode_consensus
+        try:
+            cur = st["current"]
+            pending = (
+                [bool(x) for x in cur["include_set"]],
+                int(cur["split_level"]),
+                [dec(c) for c in cur["chain"]],
+                st["inner"],
+            )
+            if (len(pending[0]) != len(self.sequences)
+                    or not isinstance(st["inner"], dict)):
+                raise ckpt_mod.CheckpointRejected(
+                    "worklist group size mismatch vs checkpoint chains"
+                )
+            return (
+                [[bool(x) for x in row] for row in st["to_split"]],
+                [int(l) for l in st["split_levels"]],
+                [[dec(c) for c in chain]
+                 for chain in st["consensus_chains"]],
+                [[dec(c) for c in chain] for chain in st["consensuses"]],
+                [[bool(x) for x in row] for row in st["assignments"]],
+                {str(k): int(v)
+                 for k, v in st["merged_counters"].items()},
+                int(st["scorer_constructions"]),
+                int(st["total_explored"]),
+                int(st["total_ignored"]),
+                int(st["peak_queue_size"]),
+                int(st["groups_solved"]),
+                pending,
+            )
+        except ckpt_mod.CheckpointError:
+            raise
+        except (KeyError, TypeError, ValueError, AttributeError) as exc:
+            raise ckpt_mod.CheckpointRejected(
+                f"malformed priority-engine checkpoint state: {exc}"
+            ) from None
+
+    @classmethod
+    def resume(
+        cls, checkpoint, extra_reads=()
+    ) -> "PriorityConsensusDWFA":
+        """An engine primed to continue ``checkpoint`` (a
+        :class:`SearchCheckpoint` or its wire-dict form); run
+        :meth:`consensus` on it to finish the search byte-identically.
+        ``extra_reads`` must be empty: chain levels fix the read set
+        (stream new reads through the single/dual engines instead)."""
+        if tuple(extra_reads):
+            raise ckpt_mod.CheckpointRejected(
+                "extra_reads are not supported for the priority engine "
+                "(sequence chains fix the read set at every level)"
+            )
+        body = ckpt_mod.resume_body(checkpoint, "priority")
+        try:
+            config = ckpt_mod.decode_config_dict(body["config"])
+            chains = [[ckpt_mod.unb64(s) for s in chain]
+                      for chain in body["chains"]]
+            offsets = [[o if o is None else int(o) for o in chain]
+                       for chain in body["offsets"]]
+            seed_groups = [sg if sg is None else int(sg)
+                           for sg in body["seed_groups"]]
+            state = body["state"]
+            if (not isinstance(state, dict)
+                    or len(chains) != len(offsets)
+                    or len(chains) != len(seed_groups)):
+                raise ckpt_mod.CheckpointRejected(
+                    "malformed priority-engine checkpoint body"
+                )
+        except ckpt_mod.CheckpointError:
+            raise
+        except (KeyError, TypeError, ValueError) as exc:
+            raise ckpt_mod.CheckpointRejected(
+                f"malformed priority-engine checkpoint body: {exc}"
+            ) from None
+        engine = cls(config)
+        for chain, offset_chain, seed_group in zip(
+            chains, offsets, seed_groups
+        ):
+            engine.add_seeded_sequence_chain(
+                chain, offset_chain, seed_group
+            )
+        engine._restore_state = {"state": state}
+        return engine

@@ -63,6 +63,7 @@ TRIGGER_REASONS = (
     "watchdog_budget_exceeded",
     "slow_search",
     "worker_lost",
+    "checkpoint_rejected",
 )
 
 DEFAULT_RING_SIZE = 2048

@@ -90,6 +90,14 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "per_worker", "workers_participating", "requeues",
         "worker_lost_incidents", "mesh_placed",
     ),
+    # the crash drill (--kill-worker) is its own mode: migration
+    # accounting fields on top of the storm-procs shape, and a mode
+    # string the trend baseline never selects
+    "storm-procs-ckpt": (
+        "parity", "procs", "jobs_per_s", "per_worker",
+        "worker_lost_incidents", "checkpoints", "migrated",
+        "restarted_started", "wasted_work_s", "migration_jobs",
+    ),
     "microbench": ("parity", "steps", "stop_code", "breakdown"),
     "north-star": ("parity", "vs_baseline", "breakdown"),
 }

@@ -11,7 +11,8 @@ declare them lost even when the OS keeps the dead peer's socket open
 (e.g. a worker wedged in a device call, not crashed).
 
 :class:`WorkerLost` is the typed error a job fails with when its
-worker dies and the door is configured not to restart started jobs
+worker dies and the door can neither migrate it (no ``CHECKPOINT``
+frame arrived yet, or ``WAFFLE_CKPT_MIGRATE=0``) nor restart it
 (``ProcConfig.restart_lost=False``) — callers can distinguish "your
 worker crashed" from an engine failure.
 """

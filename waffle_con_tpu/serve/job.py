@@ -138,6 +138,13 @@ class JobHandle:
         self._result: Any = None
         self._exception: Optional[BaseException] = None
         self._report = None
+        self._checkpoint: Any = None
+        self._checkpoint_at: Optional[float] = None
+        #: optional ``fn(wire_dict)`` invoked on every attached
+        #: checkpoint (the out-of-process worker hangs its CHECKPOINT
+        #: frame sender here); exceptions are swallowed — a broken
+        #: sink must never fail the search that snapshotted
+        self.on_checkpoint = None
         self.submitted_at = time.monotonic()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -217,6 +224,48 @@ class JobHandle:
         when reporting was off for the job's config)."""
         with self._lock:
             return self._report
+
+    @property
+    def checkpoint(self):
+        """Latest search checkpoint attached to this job (an opaque
+        wire dict, see :mod:`waffle_con_tpu.models.checkpoint`), or
+        ``None`` if the search never snapshotted.  An EXPIRED job keeps
+        its final checkpoint so the caller can resume with a fresh
+        deadline; the front door uses it to migrate a job off a lost
+        worker instead of restarting from scratch."""
+        with self._lock:
+            return self._checkpoint
+
+    @property
+    def checkpoint_at(self) -> Optional[float]:
+        """``time.monotonic()`` when :attr:`checkpoint` was attached
+        (``None`` alongside it); the migration path uses it to account
+        wasted work between the last snapshot and the crash."""
+        with self._lock:
+            return self._checkpoint_at
+
+    def _drop_checkpoint(self) -> None:
+        """Forget the attached checkpoint (restart-from-scratch paths:
+        a stale resume point must not ride into the next dispatch)."""
+        with self._lock:
+            self._checkpoint = None
+            self._checkpoint_at = None
+
+    def _attach_checkpoint(self, data: Any) -> None:
+        """Attach/replace the job's latest checkpoint (runtime side:
+        the in-process service's snapshot hook, or the front door on a
+        worker's CHECKPOINT frame)."""
+        if data is None:
+            return
+        with self._lock:
+            self._checkpoint = data
+            self._checkpoint_at = time.monotonic()
+            callback = self.on_checkpoint
+        if callback is not None:
+            try:
+                callback(data)
+            except Exception:  # noqa: BLE001 - sink must never fail a job
+                pass
 
     # -- runtime (ticket) API ------------------------------------------
 

@@ -17,14 +17,26 @@ promotes the same seam to real processes:
 * :mod:`~waffle_con_tpu.serve.procs.door` — the front door: owns
   admission, anti-starvation aging, and placement; routes to the
   least-loaded healthy worker; demotes/sheds workers from their
-  forwarded trigger stream; requeues a lost worker's jobs.
+  forwarded trigger stream; migrates or requeues a lost worker's jobs.
 
-Crash/requeue boundary: a drained or crashed worker's not-yet-started
-jobs are requeued verbatim; jobs that had already *started* on a
-crashed worker are restarted from scratch on a healthy worker when
-``ProcConfig.restart_lost`` is on (engines are deterministic, so the
-result is byte-identical — only the partial progress is lost).  Full
-mid-search state migration stays ROADMAP item 2.
+Crash/migration boundary: a drained or crashed worker's
+not-yet-started jobs are requeued verbatim; jobs that had already
+*started* **migrate** — workers stream every search checkpoint
+(periodic ``WAFFLE_CKPT_INTERVAL_S`` cadence, deadline lapse, drain)
+back as ``CHECKPOINT`` frames, and the door re-dispatches a lost
+worker's started jobs with their latest checkpoints so each search
+resumes at its last pop boundary on a healthy worker, byte-identical
+to the uninterrupted run (the checkpoint format rides the engines'
+node-identity invariant, see :mod:`waffle_con_tpu.models.checkpoint`).
+A started job with no checkpoint yet (or ``WAFFLE_CKPT_MIGRATE=0``)
+falls back to a from-scratch restart under
+``ProcConfig.restart_lost`` — deterministic engines make that
+byte-identical too, only the partial progress is lost; with
+``restart_lost=False`` it fails with the typed
+:class:`~waffle_con_tpu.runtime.liveness.WorkerLost`.  A corrupt or
+version-skewed checkpoint never fails or hangs the job either: the
+worker's service rejects it with a ``checkpoint_rejected`` flight
+incident and runs the search from scratch.
 """
 
 from waffle_con_tpu.serve.procs.door import ProcConfig, ProcFrontDoor

@@ -26,17 +26,27 @@ The door owns everything the workers must agree on exactly once:
   (``WAFFLE_PROC_PING_S``) and any frame counts as a heartbeat; a dead
   process, closed socket, or silence past ``WAFFLE_PROC_LIVENESS_S``
   marks the worker **lost**: exactly one ``worker_lost`` flight
-  trigger fires, its not-yet-started jobs are requeued to healthy
-  workers, and its *started* jobs either restart from scratch
-  (``restart_lost=True``, the default — engines are deterministic so
-  the retried result is byte-identical) or fail with the typed
-  :class:`~waffle_con_tpu.runtime.liveness.WorkerLost`.  Restart means
-  re-running, not resuming: mid-search state migration is ROADMAP
-  item 2, not this class.
-* **observability** — ``waffle_worker_*`` gauges/counters, a
-  ``workers`` table in the ``WAFFLE_STATS_FILE`` payload (the door is
-  the only stats publisher; workers run with stats disabled), runtime
-  events for every transition.
+  trigger fires and its jobs move to healthy workers — not-yet-started
+  jobs are requeued, and *started* jobs **migrate**: the door
+  re-dispatches each with the latest ``CHECKPOINT`` frame the worker
+  streamed back, so the search resumes at its last pop boundary
+  instead of re-running (byte-identical either way — the checkpoint
+  format is built on the engines' node-identity invariant, see
+  :mod:`waffle_con_tpu.models.checkpoint`).  A started job that never
+  checkpointed (or with ``WAFFLE_CKPT_MIGRATE=0``) restarts from
+  scratch under ``restart_lost=True`` (the fallback), or fails with
+  the typed :class:`~waffle_con_tpu.runtime.liveness.WorkerLost`.
+* **checkpoints** — workers snapshot long searches periodically
+  (``WAFFLE_CKPT_INTERVAL_S``), at deadline lapse, and on ``DRAIN``;
+  each snapshot lands on the door-side handle, which is also what a
+  graceful :meth:`ProcFrontDoor.close` relies on: once the admission
+  queue empties it sends ``DRAIN`` to still-busy workers, so a drain
+  that runs out of budget leaves every started job with a fresh
+  resume point instead of nothing.
+* **observability** — ``waffle_worker_*`` and ``waffle_ckpt_*``
+  gauges/counters, a ``workers`` table in the ``WAFFLE_STATS_FILE``
+  payload (the door is the only stats publisher; workers run with
+  stats disabled), runtime events for every transition.
 
 Client-side cancellation settles the door-side handle immediately;
 the worker keeps computing until its own dispatch-boundary abort and
@@ -95,6 +105,14 @@ def liveness_lapse_s() -> float:
     """``WAFFLE_PROC_LIVENESS_S`` — silence before a worker is
     declared lost (default 5 s)."""
     return envspec.get_float("WAFFLE_PROC_LIVENESS_S", 5.0)
+
+
+def migrate_enabled() -> bool:
+    """``WAFFLE_CKPT_MIGRATE`` — resume a lost worker's started jobs
+    from their last checkpoint (default on; ``0`` falls back to the
+    ``restart_lost`` restart-from-scratch path)."""
+    raw = envspec.get_raw("WAFFLE_CKPT_MIGRATE", "1") or "1"
+    return raw.strip().lower() not in ("0", "false", "off", "no")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -157,6 +175,7 @@ class _Worker:
     __slots__ = ("index", "name", "proc", "pid", "sock", "slots",
                  "state", "shed_until", "assigned", "started",
                  "routed", "demotions", "sheds", "readmits", "requeues",
+                 "migrations", "restarts", "ckpt_frames", "ckpt_bytes",
                  "reported_outstanding", "decoder", "send_lock")
 
     def __init__(self, index: int, name: str) -> None:
@@ -175,6 +194,10 @@ class _Worker:
         self.sheds = 0
         self.readmits = 0
         self.requeues = 0
+        self.migrations = 0
+        self.restarts = 0
+        self.ckpt_frames = 0
+        self.ckpt_bytes = 0
         self.reported_outstanding = 0
         self.decoder = wire.FrameDecoder()
         self.send_lock = lockcheck.make_lock(f"procs.door.send.{name}")
@@ -401,12 +424,28 @@ class ProcFrontDoor:
                 )
         budget = timeout if timeout is not None else 60.0
         deadline = time.monotonic() + budget
+        drain_sent = False
         while time.monotonic() < deadline:
             # outstanding() counts every admitted non-done handle, so a
             # job mid-route (popped from the queue, not yet in a
             # worker's assigned set) still holds the drain open
             if self.outstanding() == 0:
                 break
+            if not drain_sent and self._queue.depth() == 0:
+                # everything is routed: ask busy workers to checkpoint
+                # their running searches (DRAIN also stops late
+                # submits), so a drain that runs out of budget still
+                # leaves every started job a fresh resume point on its
+                # door-side handle
+                with self._lock:
+                    idle = not self._retry
+                    busy = [w for w in self._workers
+                            if w.state != LOST and w.sock is not None
+                            and w.assigned]
+                if idle:
+                    for worker in busy:
+                        self._send(worker, wire.FrameType.DRAIN, {})
+                    drain_sent = True
             time.sleep(0.02)
         self._stopping = True
         for worker in self._workers:
@@ -459,9 +498,15 @@ class ProcFrontDoor:
 
     # -- client API ----------------------------------------------------
 
-    def submit(self, request: JobRequest) -> JobHandle:
+    def submit(self, request: JobRequest,
+               checkpoint=None) -> JobHandle:
         """Admit one job; raises :class:`ServiceOverloaded` when the
-        bounded queue is full and :class:`ServiceClosed` after close."""
+        bounded queue is full and :class:`ServiceClosed` after close.
+
+        ``checkpoint`` resumes a previously snapshotted search (a wire
+        dict from :attr:`~waffle_con_tpu.serve.job.JobHandle.
+        checkpoint`, e.g. off an EXPIRED handle): the SUBMIT carries
+        it to whichever worker the job routes to."""
         if not isinstance(request, JobRequest):
             raise TypeError(
                 f"expected JobRequest, got {type(request).__name__}"
@@ -476,6 +521,8 @@ class ProcFrontDoor:
             handle = JobHandle(job_id, request, service=self.config.name)
             self._jobs[job_id] = handle
             self._counts["submitted"] += 1
+        if checkpoint is not None:
+            handle._attach_checkpoint(checkpoint)
         try:
             self._queue.put(handle)
         except (ServiceOverloaded, ServiceClosed):
@@ -605,13 +652,28 @@ class ProcFrontDoor:
                     ),
                 )
                 return False
+        payload = {
+            "job": handle.job_id,
+            "request": wire.encode_request(
+                handle.request, deadline_left_s=deadline_left
+            ),
+        }
+        checkpoint = handle.checkpoint
+        if checkpoint is not None:
+            # the opaque resume point rides in the SUBMIT; the door
+            # never decodes it (the worker validates CRC/version and
+            # degrades to a fresh search on rejection)
+            payload["checkpoint"] = checkpoint
         try:
-            frame = wire.encode_frame(wire.FrameType.SUBMIT, {
-                "job": handle.job_id,
-                "request": wire.encode_request(
-                    handle.request, deadline_left_s=deadline_left
-                ),
-            })
+            try:
+                frame = wire.encode_frame(wire.FrameType.SUBMIT, payload)
+            except wire.FrameTooLarge:
+                if "checkpoint" not in payload:
+                    raise
+                # an oversized checkpoint must not wedge the job: drop
+                # it and dispatch a restart-from-scratch instead
+                del payload["checkpoint"]
+                frame = wire.encode_frame(wire.FrameType.SUBMIT, payload)
         except (wire.WireError, ValueError, TypeError) as exc:
             # an unencodable request (oversized, non-finite, …) must
             # fail this one job, never the router thread
@@ -679,12 +741,36 @@ class ProcFrontDoor:
             self._on_error(worker, obj)
         elif ftype is wire.FrameType.HEALTH:
             self._apply_health(worker, obj)
+        elif ftype is wire.FrameType.CHECKPOINT:
+            self._on_checkpoint(worker, obj)
         elif ftype is wire.FrameType.PONG:
             with self._lock:
                 worker.reported_outstanding = int(
                     obj.get("outstanding", 0)
                 )
         # HELLO repeats and unknown-but-valid frames are ignored
+
+    def _on_checkpoint(self, worker: _Worker, obj: Any) -> None:
+        """Store the worker's latest snapshot on the door-side handle
+        (verbatim, never decoded) — the resume point migration and
+        deadline persistence run on."""
+        try:
+            job_id = int(obj["job"])
+            data = obj["data"]
+            size = int(obj.get("bytes", 0) or 0)
+        except (KeyError, TypeError, ValueError):
+            return  # malformed accounting frame: ignored, never fatal
+        with self._lock:
+            handle = worker.assigned.get(job_id)
+            worker.ckpt_frames += 1
+            worker.ckpt_bytes += size
+        if handle is not None:
+            handle._attach_checkpoint(data)
+        if obs_metrics.metrics_enabled():
+            reg = obs_metrics.registry()
+            labels = {"service": self.config.name, "worker": worker.name}
+            reg.counter("waffle_ckpt_snapshots_total", **labels).inc()
+            reg.counter("waffle_ckpt_bytes_total", **labels).inc(size)
 
     def _take_assigned(self, worker: _Worker,
                        job_id: int) -> Optional[JobHandle]:
@@ -718,6 +804,10 @@ class ProcFrontDoor:
                 JobStatus.CANCELLED, exception=JobCancelled(message)
             )
         elif kind == "expired":
+            # deadline persistence: keep the final checkpoint on the
+            # EXPIRED handle so the client can resubmit with a fresh
+            # budget and lose nothing
+            handle._attach_checkpoint(obj.get("checkpoint"))
             handle._finish(
                 JobStatus.EXPIRED, exception=DeadlineExceeded(message)
             )
@@ -810,10 +900,12 @@ class ProcFrontDoor:
 
     def _worker_lost(self, worker: _Worker, why: str) -> None:
         """Idempotently transition one worker to LOST: requeue its
-        not-yet-started jobs (and, with ``restart_lost``, restart its
-        started ones from scratch), fail the rest with
-        :class:`WorkerLost`, fire exactly one ``worker_lost`` flight
-        trigger."""
+        not-yet-started jobs, **migrate** its started jobs that have a
+        checkpoint (the next dispatch carries the resume point, so the
+        search continues from its last pop boundary), restart the
+        checkpoint-less rest from scratch with ``restart_lost`` or fail
+        them with :class:`WorkerLost`, and fire exactly one
+        ``worker_lost`` flight trigger."""
         with self._lock:
             if self._closed or worker.state == LOST:
                 return
@@ -837,14 +929,45 @@ class ProcFrontDoor:
             service=self.config.name, jobs_assigned=len(assigned),
         )
         requeued = 0
+        migrated = 0
+        restarted = 0
+        migrated_jobs: List[int] = []
+        wasted_s = 0.0
+        migrate = migrate_enabled()
+        now = time.monotonic()
         for job_id, handle in sorted(assigned.items()):
             if handle.done():
                 continue
-            if job_id not in started or self.config.restart_lost:
+            is_migration = (
+                job_id in started and migrate
+                and handle.checkpoint is not None
+            )
+            if job_id not in started or is_migration or \
+                    self.config.restart_lost:
                 with self._lock:
                     worker.requeues += 1
+                    if is_migration:
+                        worker.migrations += 1
+                    elif job_id in started:
+                        worker.restarts += 1
                     self._retry.append(handle)
                 requeued += 1
+                if is_migration:
+                    migrated += 1
+                    migrated_jobs.append(job_id)
+                    # work since the last snapshot is the only loss;
+                    # everything before it resumes on the next worker
+                    at = handle.checkpoint_at
+                    if at is not None:
+                        wasted_s += max(0.0, now - at)
+                elif job_id in started:
+                    restarted += 1
+                    # a restart forfeits the whole run so far; drop any
+                    # stale checkpoint so the re-dispatch is truly
+                    # from-scratch (WAFFLE_CKPT_MIGRATE=0 semantics)
+                    handle._drop_checkpoint()
+                    if handle.started_at is not None:
+                        wasted_s += max(0.0, now - handle.started_at)
             else:
                 handle._finish(
                     JobStatus.FAILED,
@@ -853,6 +976,13 @@ class ProcFrontDoor:
                         f"running job {job_id}"
                     ),
                 )
+        if migrated or restarted:
+            events.record(
+                "worker_jobs_rescued", worker=worker.name,
+                migrated=migrated, restarted=restarted,
+                migrated_jobs=migrated_jobs,
+                wasted_s=round(wasted_s, 6),
+            )
         if obs_metrics.metrics_enabled():
             reg = obs_metrics.registry()
             labels = {"service": self.config.name, "worker": worker.name}
@@ -860,6 +990,10 @@ class ProcFrontDoor:
             reg.counter(
                 "waffle_worker_requeued_total", **labels
             ).inc(requeued)
+            if migrated:
+                reg.counter(
+                    "waffle_ckpt_migrations_total", **labels
+                ).inc(migrated)
         self._publish_worker_metrics(worker)
         self._publish_stats()
 
@@ -896,11 +1030,16 @@ class ProcFrontDoor:
                     "pid": worker.pid,
                     "state": worker.state,
                     "outstanding": outstanding,
+                    "jobs": sorted(worker.assigned),
                     "slots": worker.slots,
                     "occupancy": (outstanding / worker.slots
                                   if worker.slots else 0.0),
                     "routed": worker.routed,
                     "requeues": worker.requeues,
+                    "migrations": worker.migrations,
+                    "restarts": worker.restarts,
+                    "ckpt_frames": worker.ckpt_frames,
+                    "ckpt_bytes": worker.ckpt_bytes,
                     "demotions": worker.demotions,
                     "sheds": worker.sheds,
                     "readmits": worker.readmits,
@@ -915,11 +1054,18 @@ class ProcFrontDoor:
             for job_id in [j for j, h in self._jobs.items() if h.done()]:
                 self._counts[self._jobs.pop(job_id).status.value] += 1
             counts = dict(self._counts)
+        workers = self.worker_stats()
         return {
             "jobs": counts,
             "queue_depth": self._queue.depth(),
             "aged_pops": self._queue.aged_pops,
-            "workers": self.worker_stats(),
+            "workers": workers,
+            "checkpoints": {
+                "frames": sum(w["ckpt_frames"] for w in workers),
+                "bytes": sum(w["ckpt_bytes"] for w in workers),
+                "migrations": sum(w["migrations"] for w in workers),
+                "restarts": sum(w["restarts"] for w in workers),
+            },
         }
 
     def _publish_stats(self, force: bool = False) -> None:

@@ -44,15 +44,21 @@ class FrameType(enum.IntEnum):
     """Typed frames of the door<->worker protocol."""
 
     HELLO = 1        #: worker -> door: {worker, pid, slots}
-    SUBMIT = 2       #: door -> worker: {job, request}
+    SUBMIT = 2       #: door -> worker: {job, request[, checkpoint]}
     STARTED = 3      #: worker -> door: {job}
     RESULT = 4       #: worker -> door: {job, kind, result}
-    ERROR = 5        #: worker -> door: {job, kind, type, message}
+    ERROR = 5        #: worker -> door: {job, kind, type, message
+                     #:                  [, checkpoint]}
     HEALTH = 6       #: worker -> door: forwarded flight trigger
     PING = 7         #: door -> worker: liveness probe
     PONG = 8         #: worker -> door: {outstanding, occupancy}
-    DRAIN = 9        #: door -> worker: stop accepting, finish inflight
+    DRAIN = 9        #: door -> worker: stop accepting, finish inflight;
+                     #: busy jobs snapshot a checkpoint first
     SHUTDOWN = 10    #: door -> worker: close service and exit
+    CHECKPOINT = 11  #: worker -> door: {job, data, bytes} — ``data`` is
+                     #: an opaque search-checkpoint wire dict (see
+                     #: :mod:`waffle_con_tpu.models.checkpoint`); the
+                     #: door stores it verbatim and never decodes it
 
 
 class WireError(RuntimeError):

@@ -14,13 +14,21 @@ Protocol (see :mod:`waffle_con_tpu.serve.procs.wire`):
 * every ``SUBMIT`` is decoded (typed codec, never pickle), submitted
   locally, and watched by a per-job thread that reports ``STARTED``
   when the job actually runs, then exactly one of ``RESULT`` /
-  ``ERROR`` (kind ``cancelled`` / ``expired`` / ``failed``);
+  ``ERROR`` (kind ``cancelled`` / ``expired`` / ``failed``); a SUBMIT
+  carrying a ``checkpoint`` resumes that search instead of restarting
+  it (migration off a lost worker);
+* every checkpoint the local service snapshots (periodic
+  ``WAFFLE_CKPT_INTERVAL_S`` cadence, deadline lapse, or drain) is
+  streamed back as a ``CHECKPOINT`` frame so the door always holds the
+  latest resume point for this worker's jobs — an ``expired`` ERROR
+  additionally carries the final checkpoint inline;
 * every local flight-recorder trigger is forwarded as a ``HEALTH``
   frame so the door can attribute demotions and slow searches to this
   worker without any shared memory;
 * ``PING`` answers ``PONG {outstanding, slots}``; ``DRAIN`` rejects
-  further submits while inflight jobs finish; ``SHUTDOWN`` (or socket
-  EOF — the door died) closes the service and exits.
+  further submits and asks every running search to checkpoint at its
+  next pop boundary while inflight jobs finish; ``SHUTDOWN`` (or
+  socket EOF — the door died) closes the service and exits.
 
 The module stays import-light (stdlib + wire) until :func:`main`
 actually builds the service, so spawning N workers does not pay N
@@ -147,12 +155,18 @@ class _Worker:
             exc = caught
         kind = {JobStatus.CANCELLED: "cancelled",
                 JobStatus.EXPIRED: "expired"}.get(status, "failed")
-        self.send(wire.FrameType.ERROR, {
+        frame = {
             "job": job_id,
             "kind": kind,
             "type": type(exc).__name__,
             "message": str(exc),
-        })
+        }
+        if kind == "expired" and handle.checkpoint is not None:
+            # deadline persistence: the EXPIRED verdict travels with
+            # the search's final checkpoint so the client can resubmit
+            # with a fresh budget instead of restarting from scratch
+            frame["checkpoint"] = handle.checkpoint
+        self.send(wire.FrameType.ERROR, frame)
 
     def _on_submit(self, obj: Dict) -> None:
         job_id = int(obj["job"])
@@ -165,13 +179,22 @@ class _Worker:
             return
         try:
             request = wire.decode_request(obj["request"])
-            handle = self._service.submit(request)
+            handle = self._service.submit(
+                request, checkpoint=obj.get("checkpoint")
+            )
         except Exception as exc:  # noqa: BLE001 — reported, not handled
             self.send(wire.FrameType.ERROR, {
                 "job": job_id, "kind": "failed",
                 "type": type(exc).__name__, "message": str(exc),
             })
             return
+        handle.on_checkpoint = lambda data: self.send(
+            wire.FrameType.CHECKPOINT, {
+                "job": job_id,
+                "data": data,
+                "bytes": len(json.dumps(data, separators=(",", ":"))),
+            },
+        )
         watcher = self._make_thread(
             target=self._watch, args=(job_id, handle),
             name=f"procs.worker.watch-{job_id}", daemon=True,
@@ -209,6 +232,11 @@ class _Worker:
                         self._on_ping()
                     elif ftype is wire.FrameType.DRAIN:
                         self._draining = True
+                        # snapshot every running search at its next pop
+                        # boundary: if the drain budget runs out before
+                        # a job finishes, the door already holds its
+                        # latest resume point
+                        self._service.request_checkpoints()
                     elif ftype is wire.FrameType.SHUTDOWN:
                         return
                     # anything else from the door is ignored, not fatal

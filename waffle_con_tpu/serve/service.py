@@ -172,9 +172,15 @@ class ConsensusService:
         self._next_id = 0
         self._closed = False
         self._handles: List[JobHandle] = []
+        #: job_id -> live CheckpointController (running jobs only);
+        #: request_checkpoints() fans a snapshot request out over it
+        self._controllers: Dict[int, object] = {}
         self._counts = {
             "submitted": 0, "rejected": 0, "done": 0, "failed": 0,
             "cancelled": 0, "expired": 0, "mesh_placed": 0,
+        }
+        self._ckpt_counts = {
+            "snapshots": 0, "bytes": 0, "resumed": 0, "rejected": 0,
         }
         if autostart:
             self.start()
@@ -220,9 +226,18 @@ class ConsensusService:
 
     # -- client API ----------------------------------------------------
 
-    def submit(self, request: JobRequest) -> JobHandle:
+    def submit(self, request: JobRequest,
+               checkpoint=None) -> JobHandle:
         """Admit one job; raises :class:`ServiceOverloaded` when the
-        bounded queue is full and :class:`ServiceClosed` after close."""
+        bounded queue is full and :class:`ServiceClosed` after close.
+
+        ``checkpoint`` optionally resumes a previously snapshotted
+        search (a wire dict from :attr:`JobHandle.checkpoint`): the
+        worker picks the search up at the recorded queue state instead
+        of restarting from scratch.  A corrupt, version-skewed, or
+        mismatched checkpoint never fails the job — it degrades to a
+        fresh search with a ``checkpoint_rejected`` flight incident.
+        """
         if not isinstance(request, JobRequest):
             raise TypeError(
                 f"expected JobRequest, got {type(request).__name__}"
@@ -235,6 +250,8 @@ class ConsensusService:
                 self._next_id, request, service=self.config.name
             )
             self._next_id += 1
+        if checkpoint is not None:
+            handle._attach_checkpoint(checkpoint)
         try:
             self._queue.put(handle)
         except ServiceOverloaded:
@@ -346,6 +363,19 @@ class ConsensusService:
             profile and obs_phases.profiling_enabled()
         ) else None
         job_t0 = time.monotonic()
+        from waffle_con_tpu.models import checkpoint as ckpt_mod
+
+        ctrl = ckpt_mod.CheckpointController(
+            interval_s=envspec.get_float("WAFFLE_CKPT_INTERVAL_S", 30.0),
+            max_bytes=envspec.get_int(
+                "WAFFLE_CKPT_MAX_BYTES", 8 * 1024 * 1024, lo=0
+            ),
+            deadline=handle.deadline,
+            on_snapshot=lambda ckpt: self._deliver_checkpoint(handle, ckpt),
+            label=f"job {handle.job_id}",
+        )
+        with self._lock:
+            self._controllers[handle.job_id] = ctrl
         try:
             with obs_trace.span(
                 "serve:job", "serve",
@@ -357,8 +387,24 @@ class ConsensusService:
                 # The device-set scope pins any mesh-promoted scorer
                 # this job builds onto the service's device slice.
                 with self._device_scope(), ops_ragged.serve_scope():
-                    engine = _build_engine(handle.request)
-                    result = engine.consensus()
+                    engine = self._make_engine(handle)
+                    try:
+                        with ckpt_mod.installed(ctrl):
+                            result = engine.consensus()
+                    except ckpt_mod.CheckpointRejected as exc:
+                        # the engines defer checkpoint-body validation
+                        # until the restore state is consumed inside
+                        # consensus(); degrade exactly like a
+                        # construction-time rejection — restart from
+                        # scratch, never fail the job
+                        self._record_ckpt_rejection(handle, exc)
+                        with self._lock:
+                            # it never actually resumed
+                            self._ckpt_counts["resumed"] -= 1
+                        handle._drop_checkpoint()
+                        engine = _build_engine(handle.request)
+                        with ckpt_mod.installed(ctrl):
+                            result = engine.consensus()
         except BaseException as exc:
             self._finalize(handle, exc)
         else:
@@ -372,6 +418,8 @@ class ConsensusService:
                     handle, time.monotonic() - job_t0, phases_before
                 )
         finally:
+            with self._lock:
+                self._controllers.pop(handle.job_id, None)
             set_scorer_decorator(previous)
             # page-table residency ends with the job: whatever scorers
             # it admitted into the band-state arena free their pages now
@@ -383,6 +431,90 @@ class ConsensusService:
                 pass
             self._dispatcher.job_finished()
             obs_trace.set_current_context(prev_ctx)
+
+    def _make_engine(self, handle: JobHandle):
+        """Build the job's engine — resuming from the handle's attached
+        checkpoint when one is present (migration / incremental-read
+        path).  A rejected checkpoint degrades to a fresh search with a
+        ``checkpoint_rejected`` flight incident; it never fails or
+        hangs the job."""
+        from waffle_con_tpu.models import checkpoint as ckpt_mod
+
+        wire_ckpt = handle.checkpoint
+        if wire_ckpt is not None:
+            try:
+                checkpoint = ckpt_mod.SearchCheckpoint.from_wire(wire_ckpt)
+                if checkpoint.kind != handle.request.kind:
+                    raise ckpt_mod.CheckpointRejected(
+                        f"{handle.request.kind} job cannot resume a "
+                        f"{checkpoint.kind!r} checkpoint"
+                    )
+                engine = ckpt_mod.resume_engine(checkpoint)
+            except ckpt_mod.CheckpointRejected as exc:
+                self._record_ckpt_rejection(handle, exc)
+            else:
+                with self._lock:
+                    self._ckpt_counts["resumed"] += 1
+                events.record(
+                    "job_resumed", job_id=handle.job_id,
+                    job_kind=handle.request.kind,
+                    service=self.config.name,
+                )
+                return engine
+        return _build_engine(handle.request)
+
+    def _record_ckpt_rejection(self, handle: JobHandle, exc) -> None:
+        """Account one rejected checkpoint (counter, event log, typed
+        flight incident, metric) — shared by the construction-time and
+        deferred (mid-``consensus()``) degrade paths."""
+        with self._lock:
+            self._ckpt_counts["rejected"] += 1
+        events.record(
+            "checkpoint_rejected", job_id=handle.job_id,
+            service=self.config.name, why=str(exc),
+        )
+        obs_flight.trigger(
+            "checkpoint_rejected",
+            trace_id=handle.trace.trace_id,
+            job_id=handle.job_id, job_kind=handle.request.kind,
+            service=self.config.name, why=str(exc),
+        )
+        if obs_metrics.metrics_enabled():
+            obs_metrics.registry().counter(
+                "waffle_ckpt_rejected_total",
+                service=self.config.name,
+            ).inc()
+
+    def _deliver_checkpoint(self, handle: JobHandle, checkpoint) -> None:
+        """Controller snapshot hook: attach the wire form to the handle
+        (which forwards it to any ``on_checkpoint`` sink) and count."""
+        size = checkpoint.byte_size()
+        handle._attach_checkpoint(checkpoint.to_wire())
+        with self._lock:
+            self._ckpt_counts["snapshots"] += 1
+            self._ckpt_counts["bytes"] += size
+        obs_flight.record(
+            "job_checkpoint", trace_id=handle.trace.trace_id,
+            job_id=handle.job_id, bytes=size,
+        )
+        if obs_metrics.metrics_enabled():
+            reg = obs_metrics.registry()
+            reg.counter(
+                "waffle_ckpt_snapshots_total", service=self.config.name
+            ).inc()
+            reg.counter(
+                "waffle_ckpt_bytes_total", service=self.config.name
+            ).inc(size)
+
+    def request_checkpoints(self, preempt: bool = False) -> int:
+        """Ask every running job to snapshot at its next pop boundary
+        (the drain / pre-migration path); with ``preempt`` the searches
+        also stop there.  Returns how many jobs were signalled."""
+        with self._lock:
+            controllers = list(self._controllers.values())
+        for ctrl in controllers:
+            ctrl.request_snapshot(preempt=preempt)
+        return len(controllers)
 
     def _record_placement_outcome(self, handle: JobHandle, wall_s: float,
                                   phases_before) -> None:
@@ -508,8 +640,10 @@ class ConsensusService:
         bench's ``--serve`` evidence embeds this dict verbatim)."""
         with self._lock:
             counts = dict(self._counts)
+            ckpt_counts = dict(self._ckpt_counts)
         return {
             "jobs": counts,
+            "checkpoints": ckpt_counts,
             "queue_depth": self._queue.depth(),
             "aged_pops": self._queue.aged_pops,
             "dispatch": self._dispatcher.stats(),

@@ -166,6 +166,18 @@ KNOBS: Dict[str, EnvKnob] = dict((
        "floor; the default is the documented 1-core time-slicing "
        "sanity value (measured 0.34-0.42) -- raise toward 1.5 on "
        "real multi-core hosts"),
+    _k("WAFFLE_CKPT_INTERVAL_S", "float", "30",
+       "Serving: periodic search-checkpoint interval in seconds for "
+       "jobs run under a service/worker (0 disables periodic "
+       "snapshots; deadline and drain snapshots still fire)"),
+    _k("WAFFLE_CKPT_MAX_BYTES", "int", "8388608",
+       "Serving: checkpoints whose wire JSON exceeds this many bytes "
+       "are dropped (never truncated) -- the job stays restartable "
+       "from scratch (8 MiB)"),
+    _k("WAFFLE_CKPT_MIGRATE", "flag", "1 (on)",
+       "Front door: resume a lost worker's started jobs from their "
+       "last checkpoint on another worker; 0 falls back to "
+       "restart-from-scratch (restart_lost)"),
 ))
 
 
