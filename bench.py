@@ -1410,7 +1410,8 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
 
 
 def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
-                      kill_worker=False):
+                      kill_worker=False, trace_out=None,
+                      supervised=False):
     """Out-of-process storm (``--storm N --procs P``): the exact
     workload and arrival schedule of :func:`bench_storm`, fired at the
     :class:`~waffle_con_tpu.serve.procs.door.ProcFrontDoor` with real
@@ -1436,20 +1437,49 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
     the last snapshot and the crash), and per-migrated-job post-kill
     wall vs from-scratch wall — and lands as its own
     ``storm-procs-ckpt`` perfdb kind, so crash drills never join the
-    ``storm-procs`` trend baseline."""
+    ``storm-procs`` trend baseline.
+
+    ``trace_out`` arms the fleet observability plane (tracing +
+    metrics): the multi-worker phase is captured as ONE stitched Chrome
+    trace — door spans and worker spans on the same per-job timeline,
+    flow arrows across the socket hop — written to ``trace_out``, the
+    evidence line gains the federated ``metrics`` snapshot (worker
+    series merged under ``worker=`` labels) plus a ``fleet`` block.
+
+    ``supervised=True`` routes the *served* jobs through the
+    fault-tolerant supervisor inside each worker (serial references and
+    evidence baselines stay unsupervised), which is where
+    ``WAFFLE_FAULTS`` injection applies: the spec is popped from the
+    environment up front (serial refs must run clean) and re-exported
+    only for the multi-worker phase, whose freshly spawned workers
+    inherit it — the CI fleet-observability smoke uses this to prove a
+    worker-side flight trigger surfaces as a door-side incident file."""
     import signal
 
     from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.obs import metrics as obs_metrics
     from waffle_con_tpu.obs import slo as obs_slo
+    from waffle_con_tpu.runtime import faults as runtime_faults
     from waffle_con_tpu.serve import (
         JobRequest,
         PlacementPolicy,
         ProcConfig,
         ProcFrontDoor,
     )
+    from waffle_con_tpu.utils import envspec
+
+    fault_spec = ""
+    if supervised and envspec.get_raw("WAFFLE_FAULTS"):
+        # defuse the env plan now (door-side serial refs run clean);
+        # re-exported just before the multi-worker phase so only its
+        # spawned workers inherit the injection
+        fault_spec = os.environ.pop("WAFFLE_FAULTS")
+        runtime_faults.install(None)
+
+    tracer = _obs_setup(trace_out)
 
     (shapes, priorities, jobs, offsets, arrival_span,
-     large_threshold) = _storm_mix(num_jobs, error_rate, False)
+     large_threshold) = _storm_mix(num_jobs, error_rate, supervised)
 
     anchor_idx = None
     if kill_worker:
@@ -1509,9 +1539,10 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         try:
             for _attempt in range(1 + timed_passes):
                 reqs = [
-                    JobRequest(kind="single", reads=reads, config=cfg,
+                    JobRequest(kind="single", reads=reads,
+                               config=(scfg if supervised else cfg),
                                priority=prio)
-                    for (reads, cfg, _scfg), prio in zip(jobs, priorities)
+                    for (reads, cfg, scfg), prio in zip(jobs, priorities)
                 ]
                 t0 = time.perf_counter()
                 handles = []
@@ -1596,9 +1627,24 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
                        kill_handles, warm_lats)
 
     s_wall, _s_lat, _s_stats, _s_workers, s_parity = run_phase(1)[:5]
+    if fault_spec:
+        # restore the env plan for the multi-worker phase only: its
+        # workers spawn after this and resolve WAFFLE_FAULTS lazily
+        # (the door process itself stays defused)
+        os.environ["WAFFLE_FAULTS"] = fault_spec
+    if tracer is not None:
+        # the written trace covers exactly the multi-worker phase
+        tracer.clear()
     (m_wall, m_lat, m_stats, m_workers, m_parity, killed,
      kill_mono, kill_handles, warm_lats) = run_phase(procs,
                                                      kill=kill_worker)
+    trace_spans = 0
+    if tracer is not None:
+        trace_spans = sum(
+            1 for ev in tracer.chrome_events() if ev.get("ph") == "X"
+        )
+        if trace_out:
+            tracer.write_chrome_trace(trace_out)
 
     parity = s_parity and m_parity
     p50 = m_lat[len(m_lat) // 2]
@@ -1638,6 +1684,21 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         "restarted_started": sum(w["restarts"] for w in m_workers),
         "checkpoints": m_stats.get("checkpoints", {}),
         "worker_lost_incidents": len(lost_incidents),
+        "fleet": {
+            "per_worker_dispatch_p95_s": {
+                w["worker"]: w.get("dispatch_p95_s") for w in m_workers
+            },
+            "stats_frames": m_stats.get("fleet", {}).get(
+                "stats_frames", 0
+            ),
+            "incidents_forwarded": m_stats.get("fleet", {}).get(
+                "incidents_forwarded", 0
+            ),
+            "span_events": m_stats.get("fleet", {}).get(
+                "span_events", 0
+            ),
+            "trace_spans": trace_spans,
+        },
         "slo": obs_slo.snapshot(),
         "incidents": [
             {k: inc.get(k) for k in
@@ -1646,6 +1707,14 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         ],
         "runtime_events": _runtime_events(),
     }
+    if obs_metrics.metrics_enabled():
+        out["metrics"] = obs_metrics.registry().snapshot()
+    if trace_out and tracer is not None:
+        out["trace_out"] = trace_out
+    if supervised:
+        out["supervised"] = True
+    if fault_spec:
+        out["faults"] = fault_spec
     if kill_worker:
         from waffle_con_tpu.runtime import events as runtime_events
 
@@ -2296,13 +2365,17 @@ def main() -> None:
                 args.storm,
                 procs=args.procs,
                 kill_worker=args.kill_worker,
+                trace_out=args.trace_out,
+                supervised=args.serve_supervised,
             )
             out["device_platform"] = _current_platform()
             # crash drills measure degraded-mode behaviour: they land
             # as their own storm-procs-ckpt kind (migration accounting)
-            # and never join the storm-procs trend baseline
-            _emit(out, perfdb_kind="storm-procs-ckpt"
-                  if out.get("kill_worker") else "storm-procs")
+            # and never join the storm-procs trend baseline; fault-
+            # injected (fleet-observability smoke) runs never join any
+            _emit(out, perfdb_kind=None if out.get("faults") else (
+                "storm-procs-ckpt" if out.get("kill_worker")
+                else "storm-procs"))
             return
         out = bench_storm(
             args.storm,

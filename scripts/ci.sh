@@ -481,6 +481,110 @@ print(
 )
 PY
 
+echo "== storm-procs fleet observability smoke (traced + fault-injected) =="
+FLEET_OUT="$(mktemp /tmp/waffle_ci_fleet.XXXXXX.json)"
+FLEET_TRACE="$(mktemp /tmp/waffle_ci_fleet_trace.XXXXXX.json)"
+FLEET_FLIGHT="$(mktemp -d /tmp/waffle_ci_fleet_flight.XXXXXX)"
+trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT" "$MIX_OUT" "$STORM_OUT" "$SHED_OUT" "$PROCS_OUT" "$KILL_OUT" "$FLEET_OUT" "$FLEET_TRACE" "$FLEET_FLIGHT"' EXIT
+
+# the full fleet observability plane, armed: --trace-out turns on
+# tracing + metrics in the door AND (via the worker spec) in every
+# spawned worker; a dense STATS cadence federates worker metric
+# snapshots during the short run; the injected jax timeouts fire
+# inside the *workers* (bench pops WAFFLE_FAULTS before the serial
+# refs and re-exports it only for the multi-worker phase), so the
+# incident files below prove the worker->door INCIDENT path, not a
+# door-local recorder.  Fault runs write no perfdb record, so this
+# smoke can never move the storm-procs trend baseline.
+WAFFLE_LOCKCHECK=1 WAFFLE_PROC_STATS_S=0.3 \
+  WAFFLE_FAULTS="timeout:jax:*:*:2" WAFFLE_FLIGHT_DIR="$FLEET_FLIGHT" \
+  python bench.py --storm 8 --procs 2 --serve-supervised \
+  --trace-out "$FLEET_TRACE" --platform cpu > "$FLEET_OUT"
+
+python - "$FLEET_OUT" "$FLEET_TRACE" "$FLEET_FLIGHT" <<'PY'
+import glob
+import json
+import sys
+
+out_path, trace_path, flight_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+
+with open(out_path) as fh:
+    evidence = json.loads(fh.read().strip().splitlines()[-1])
+assert evidence.get("mode") == "storm-procs", sorted(evidence)
+assert evidence.get("supervised") is True, sorted(evidence)
+assert evidence.get("faults"), "fault spec missing from evidence"
+assert evidence["parity"] is True, (
+    "fleet-observability storm diverged from serial"
+)
+
+# federated metrics: the door's exposition must carry each worker's
+# snapshot as worker=-labelled series
+fleet = evidence["fleet"]
+assert fleet["stats_frames"] >= 1, fleet
+assert fleet["span_events"] >= 1, fleet
+series_labels = [
+    label
+    for family in evidence["metrics"].values()
+    for label in family.get("series", {})
+]
+for wname in ("storm:w0", "storm:w1"):
+    assert any(f'worker="{wname}"' in lbl for lbl in series_labels), (
+        f"no federated series for {wname} in the merged registry"
+    )
+
+# distributed tracing: one job's spans must come from BOTH sides of
+# the socket (door spans have no args.worker; ingested worker spans
+# do), stitched onto the same per-job chrome pid, with flow arrows
+with open(trace_path) as fh:
+    events = json.load(fh)["traceEvents"]
+spans = [e for e in events if e.get("ph") == "X"]
+assert spans, "trace file has no spans"
+door_pids = {e["pid"] for e in spans
+             if not (e.get("args") or {}).get("worker")}
+worker_pids = {e["pid"] for e in spans
+               if (e.get("args") or {}).get("worker")}
+stitched = door_pids & worker_pids
+assert stitched, (
+    f"no job pid with spans from 2 processes "
+    f"(door-only={sorted(door_pids)[:4]}, "
+    f"worker-only={sorted(worker_pids)[:4]})"
+)
+names = {e["name"] for e in spans if e["pid"] in stitched}
+assert "door:job" in names and "serve:job" in names, sorted(names)
+flow_starts = {e["id"] for e in events if e.get("ph") == "s"}
+flow_ends = {e["id"] for e in events if e.get("ph") == "f"}
+assert flow_starts & flow_ends, "no matched flow arrow pair"
+
+# incident aggregation: a worker-side flight trigger must surface as
+# exactly one door-side dump per forwarded incident, attributed to
+# the worker that hit it (workers are spawned without
+# WAFFLE_FLIGHT_DIR, so every file here came from the door's
+# re-ingest)
+assert fleet["incidents_forwarded"] >= 1, fleet
+dumps = sorted(glob.glob(f"{flight_dir}/incident-*.json"))
+assert dumps, f"no door-side incident dump in {flight_dir}"
+keys = []
+for path in dumps:
+    with open(path) as fh:
+        incident = json.load(fh)
+    assert incident["origin"] == "remote", incident
+    assert str(incident.get("worker", "")).startswith("storm:w"), incident
+    keys.append((incident["reason"], incident["trace_id"],
+                 incident["worker"]))
+assert len(keys) == len(set(keys)), f"duplicate incident dumps: {keys}"
+assert len(dumps) == fleet["incidents_forwarded"], (
+    f"{len(dumps)} dump(s) for {fleet['incidents_forwarded']} "
+    f"forwarded incident(s)"
+)
+print(
+    f"ci fleet observability smoke ok: "
+    f"{fleet['stats_frames']} STATS frame(s), "
+    f"{fleet['span_events']} ingested span event(s), "
+    f"{len(stitched)} stitched job(s), "
+    f"{len(dumps)} attributed incident dump(s), parity held"
+)
+PY
+
 echo "== perfdb serving trend gate (serve-mix + storm jobs/s) =="
 # the serving smokes above appended their records; gate each kind's
 # latest against its own same-platform, same-metric rolling baseline.

@@ -12,7 +12,11 @@ serving) the per-worker table shows pid, health state, outstanding
 jobs, slot occupancy, requeue/demote/shed counters, and the
 checkpoint/migration columns (frames + bytes streamed, jobs migrated
 from a checkpoint vs restarted from scratch) instead of the
-in-process replica table.
+in-process replica table.  A door running the fleet observability
+plane additionally gets a ``fleet`` section: per-worker metric
+snapshot age (from the worker's last STATS frame), forwarded
+incident counts, each worker's own rolling dispatch p95, and the
+door-side e2e job p50/p95 the whole fleet is judged by.
 
 Usage::
 
@@ -150,6 +154,35 @@ def render(payload: dict, plain: bool = False) -> str:
                 f"{wkr.get('demotions', 0):>6} "
                 f"{wkr.get('sheds', 0):>4} "
                 f"{wkr.get('readmits', 0):>7}"
+            )
+
+    fleet = payload.get("fleet")
+    if fleet and workers:
+        slo_all = payload.get("slo", {})
+        job_w = slo_all.get("job", {})
+        lines.append(
+            f"{bold}fleet{reset} "
+            f"stats_frames={fleet.get('stats_frames', 0)} "
+            f"incidents_forwarded={fleet.get('incidents_forwarded', 0)} "
+            f"span_events={fleet.get('span_events', 0)}  "
+            f"e2e p50={_fmt_s(job_w.get('p50_s'))} "
+            f"p95={_fmt_s(job_w.get('p95_s'))}"
+        )
+        lines.append(
+            f"  {'worker':<16} {'snap_age':>8} {'stats':>5} "
+            f"{'incid':>5} {'spans':>6} {'disp_p95':>9}"
+        )
+        now = payload.get("unix_time") or time.time()
+        for wkr in workers:
+            at = wkr.get("stats_at")
+            snap_age = f"{max(0.0, now - at):.1f}s" if at else "-"
+            lines.append(
+                f"  {str(wkr.get('worker', '?'))[:16]:<16} "
+                f"{snap_age:>8} "
+                f"{wkr.get('stats_frames', 0):>5} "
+                f"{wkr.get('incidents', 0):>5} "
+                f"{wkr.get('span_events', 0):>6} "
+                f"{_fmt_s(wkr.get('dispatch_p95_s')):>9}"
             )
 
     slo = payload.get("slo", {})

@@ -1,0 +1,510 @@
+"""Fleet observability plane: cross-process distributed tracing,
+federated metrics, and incident aggregation for the proc fleet.
+
+Layers under test:
+
+* **wire** — the SUBMIT ``trace`` context codec (valid dicts decode to
+  exactly the normalized shape; malformed ones raise typed
+  ``WireError``; a 300-mutation fuzz of SUBMIT-with-trace frames never
+  raises anything untyped), and the obs-side
+  ``context_to_wire``/``context_from_wire`` round trip.
+* **door, fake workers** — STATS frames merge into the door registry
+  as ``worker=``-labeled series in one ``render_prometheus()``
+  exposition; INCIDENT frames land exactly once in the door's
+  ``WAFFLE_FLIGHT_DIR`` under fleet-level ``(reason, trace_id)``
+  dedupe with worker attribution; with tracing/metrics disabled the
+  SUBMIT payload carries **no** ``trace`` key at all (frames absent,
+  not empty).
+* **real subprocess** — one served job yields one *connected* span
+  tree containing both door-side spans (``door:job``/``door:queued``)
+  and worker-side spans (``serve:job``/``search``) under the same
+  trace id and Chrome pid, stitched by flow arrows across the socket
+  hop; with the plane disarmed a real worker sends zero STATS frames
+  and returns zero span events.
+"""
+
+import json
+import os
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.models.consensus import Consensus
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.obs import slo as obs_slo
+from waffle_con_tpu.obs import trace as obs_trace
+from waffle_con_tpu.serve import (
+    JobRequest,
+    ProcConfig,
+    ProcFrontDoor,
+)
+from waffle_con_tpu.serve.procs import wire
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------
+# trace-context wire codec
+# ---------------------------------------------------------------------
+
+def test_trace_context_wire_roundtrip():
+    ctx = obs_trace.TraceContext("storm/job-3", 1_000_003,
+                                 label="job-3 [tag]")
+    obj = obs_trace.context_to_wire(ctx, parent_span_id=1,
+                                    span_base=2_000_000, flow_id=48)
+    decoded = wire.decode_trace(obj)
+    assert decoded == {
+        "trace_id": "storm/job-3",
+        "chrome_pid": 1_000_003,
+        "label": "job-3 [tag]",
+        "parent_span_id": 1,
+        "span_base": 2_000_000,
+        "flow_id": 48,
+    }
+    adopted = obs_trace.context_from_wire(decoded)
+    assert adopted.trace_id == ctx.trace_id
+    assert adopted.chrome_pid == ctx.chrome_pid
+    assert adopted.root_parent == 1
+    # adopted span ids start above the disjoint base, parenting the
+    # first stack-root span under the door's per-job root
+    span_id, parent = adopted._open_span()
+    assert span_id == 2_000_001
+    assert parent == 1
+
+
+def test_decode_trace_optional_and_malformed():
+    assert wire.decode_trace(None) is None
+    minimal = wire.decode_trace(
+        {"trace_id": "t", "chrome_pid": 5}
+    )
+    assert minimal["span_base"] == 0
+    assert minimal["parent_span_id"] is None
+    assert minimal["flow_id"] is None
+    for bad in (
+        [],                                    # not a dict
+        {"chrome_pid": 5},                     # missing trace_id
+        {"trace_id": "t"},                     # missing chrome_pid
+        {"trace_id": "t", "chrome_pid": "x"},  # non-int pid
+        {"trace_id": "t", "chrome_pid": -1},   # negative pid
+        {"trace_id": "t", "chrome_pid": 5, "span_base": -2},
+        {"trace_id": "t", "chrome_pid": 5, "parent_span_id": "n"},
+    ):
+        with pytest.raises(wire.WireError):
+            wire.decode_trace(bad)
+
+
+def test_submit_with_trace_fuzz_never_untyped(monkeypatch):
+    # mutated SUBMIT frames carrying the new trace fields must always
+    # either decode cleanly or raise a typed WireError — and when they
+    # decode, decode_trace on the (possibly mangled) trace dict must
+    # itself stay typed
+    monkeypatch.setenv("WAFFLE_PROC_FRAME_MAX", "65536")
+    ctx = obs_trace.TraceContext("fuzz/job-1", 1_000_001)
+    base = wire.encode_frame(wire.FrameType.SUBMIT, {
+        "job": 1,
+        "request": {"kind": "single", "reads": ["QUNHVA=="]},
+        "trace": obs_trace.context_to_wire(
+            ctx, parent_span_id=1, span_base=1_000_000, flow_id=16
+        ),
+    })
+    rng = random.Random(20260806)
+    for _ in range(300):
+        blob = bytearray(base)
+        for _ in range(rng.randint(1, 4)):
+            blob[rng.randrange(len(blob))] = rng.randrange(256)
+        try:
+            frames = wire.FrameDecoder().feed(bytes(blob))
+        except wire.WireError:
+            continue
+        for _ftype, obj in frames:
+            if not isinstance(obj, dict):
+                continue
+            try:
+                wire.decode_trace(obj.get("trace"))
+            except wire.WireError:
+                pass
+
+
+# ---------------------------------------------------------------------
+# federated metrics: registry merge + door-level STATS
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def metrics_on():
+    obs_metrics.enable_metrics(True)
+    obs_metrics.registry().reset()
+    try:
+        yield obs_metrics.registry()
+    finally:
+        obs_metrics.registry().reset()
+        obs_metrics.reset_metrics_enabled()
+
+
+def test_merge_snapshot_relabels_series(metrics_on):
+    reg = metrics_on
+    snap = {
+        "waffle_searches_total": {
+            "type": "counter",
+            "series": {'{backend="python"}': 4.0, "{}": 2.0},
+        },
+        "waffle_serve_active_jobs": {
+            "type": "gauge", "series": {"{}": 3.0},
+        },
+        "waffle_dispatch_latency_seconds": {
+            "type": "histogram",
+            "series": {'{op="run"}': {
+                "buckets": {"0.01": 2, "0.1": 5}, "overflow": 1,
+                "sum": 0.4, "count": 8,
+            }},
+        },
+    }
+    assert reg.merge_snapshot(snap, worker="s:w0") == 4
+    text = reg.render_prometheus()
+    assert 'waffle_searches_total{backend="python",worker="s:w0"} 4.0' \
+        in text
+    assert 'waffle_serve_active_jobs{worker="s:w0"} 3.0' in text
+    assert 'waffle_dispatch_latency_seconds_count{op="run",worker="s:w0"}' \
+        " 8" in text
+    # re-merging a newer snapshot SETS the value (no double counting)
+    snap["waffle_searches_total"]["series"]['{backend="python"}'] = 6.0
+    reg.merge_snapshot(snap, worker="s:w0")
+    assert 'backend="python",worker="s:w0"} 6.0' \
+        in reg.render_prometheus()
+
+
+def test_merge_snapshot_skips_malformed_series(metrics_on):
+    reg = metrics_on
+    reg.counter("waffle_fleet_clash_total").inc()
+    merged = reg.merge_snapshot({
+        "not_a_family": "bogus",
+        "waffle_fleet_clash_total": {            # kind collision
+            "type": "gauge", "series": {"{}": 1.0},
+        },
+        "waffle_bad_value": {
+            "type": "counter", "series": {"{}": "NaNsense?"},
+        },
+        "waffle_good": {"type": "counter", "series": {"{}": 2.0}},
+    }, worker="w")
+    assert merged == 1
+    assert 'waffle_good{worker="w"} 2.0' in reg.render_prometheus()
+
+
+class _ObsWorker:
+    """Minimal scripted worker for the fleet-obs door paths: HELLO,
+    answers SUBMIT, captures every SUBMIT payload, and sends whatever
+    STATS/INCIDENT frames the test scripts via :meth:`send`."""
+
+    def __init__(self, socket_path, name, spec):
+        self.name = name
+        self.spec = json.loads(spec)
+        self.submits = []
+        self.pid = os.getpid()
+        self._sock = None
+        self._connected = threading.Event()
+        self._exited = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(socket_path,), daemon=True
+        )
+        self._thread.start()
+
+    def poll(self):
+        return None if not self._exited.is_set() else 0
+
+    def wait(self, timeout=None):
+        self._exited.wait(timeout)
+        return 0
+
+    def terminate(self):
+        self._exited.set()
+
+    kill = terminate
+
+    def send(self, ftype, obj):
+        assert self._connected.wait(5)
+        self._sock.sendall(wire.encode_frame(ftype, obj))
+
+    def _run(self, socket_path):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(socket_path)
+        self._sock = sock
+        decoder = wire.FrameDecoder()
+        sock.sendall(wire.encode_frame(wire.FrameType.HELLO, {
+            "worker": self.name, "pid": self.pid, "slots": 2,
+        }))
+        self._connected.set()
+        try:
+            while True:
+                data = sock.recv(65536)
+                if not data:
+                    return
+                for ftype, obj in decoder.feed(data):
+                    if ftype is wire.FrameType.PING:
+                        sock.sendall(wire.encode_frame(
+                            wire.FrameType.PONG, {"outstanding": 0},
+                        ))
+                    elif ftype is wire.FrameType.SUBMIT:
+                        self.submits.append(obj)
+                        result = [Consensus(
+                            b"FAKE", ConsensusCost.L1_DISTANCE, [0, 0]
+                        )]
+                        sock.sendall(wire.encode_frame(
+                            wire.FrameType.STARTED, {"job": obj["job"]}
+                        ))
+                        sock.sendall(wire.encode_frame(
+                            wire.FrameType.RESULT, {
+                                "job": obj["job"], "kind": "single",
+                                "result": wire.encode_result(
+                                    "single", result
+                                ),
+                            }
+                        ))
+                    elif ftype is wire.FrameType.SHUTDOWN:
+                        return
+        except OSError:
+            pass
+        finally:
+            self._exited.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _ObsFleet:
+    def __init__(self):
+        self.workers = {}
+
+    def __call__(self, socket_path, name, spec):
+        worker = _ObsWorker(socket_path, name, spec)
+        self.workers[name] = worker
+        return worker
+
+
+def _request():
+    return JobRequest(kind="single", reads=(b"ACGT", b"ACGT"),
+                      config=CdwfaConfig())
+
+
+def _door(fleet, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("name", "fleet")
+    kw.setdefault("spawn_timeout_s", 10.0)
+    return ProcFrontDoor(ProcConfig(launcher=fleet, **kw))
+
+
+def test_stats_frame_merges_as_worker_labeled_series(metrics_on):
+    fleet = _ObsFleet()
+    with _door(fleet) as door:
+        door.submit(_request()).result(timeout=10)
+        for name, worker in fleet.workers.items():
+            worker.send(wire.FrameType.STATS, {
+                "worker": name,
+                "unix_time": time.time(),
+                "metrics": {
+                    "waffle_searches_total": {
+                        "type": "counter", "series": {"{}": 5.0},
+                    },
+                },
+                "slo": {"dispatch": {"count": 5, "p95_s": 0.025}},
+                "incidents": 0,
+            })
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            rows = door.worker_stats()
+            if all(w["stats_frames"] == 1 for w in rows):
+                break
+            time.sleep(0.01)
+        rows = {w["worker"]: w for w in door.worker_stats()}
+        stats = door.stats()
+    assert all(w["stats_frames"] == 1 for w in rows.values()), rows
+    assert all(w["stats_at"] is not None for w in rows.values())
+    assert all(w["dispatch_p95_s"] == 0.025 for w in rows.values())
+    assert stats["fleet"]["stats_frames"] == 2
+    # one exposition, one series per worker
+    text = metrics_on.render_prometheus()
+    for name in rows:
+        assert f'waffle_searches_total{{worker="{name}"}} 5.0' in text
+    # the workers' spec told them to arm metrics
+    assert all(w.spec["metrics"] for w in fleet.workers.values())
+
+
+def test_forwarded_incident_dumped_once_with_attribution(
+        tmp_path, monkeypatch, metrics_on):
+    monkeypatch.setenv("WAFFLE_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("WAFFLE_FLIGHT_DEDUPE_S", "60")
+    obs_flight.reset()
+    incident = {
+        "schema": "waffle-flight-incident/1",
+        "seq": 7,
+        "reason": "backend_demoted",
+        "trace_id": "fleet/job-1",
+        "unix_time": time.time(),
+        "detail": {"why": "injected"},
+        "path": "/worker/side/incident-000007.json",
+    }
+    fleet = _ObsFleet()
+    try:
+        with _door(fleet, workers=1) as door:
+            worker = fleet.workers["fleet:w0"]
+            for _ in range(2):  # same (reason, trace_id): fleet dedupe
+                worker.send(wire.FrameType.INCIDENT, {
+                    "worker": worker.name, "incident": dict(incident),
+                })
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if door.worker_stats()[0]["incidents"] == 2:
+                    break
+                time.sleep(0.01)
+            stats = door.stats()
+        assert stats["fleet"]["incidents_forwarded"] == 2
+        dumps = sorted(tmp_path.glob("incident-*.json"))
+        assert len(dumps) == 1, [d.name for d in dumps]
+        dumped = json.loads(dumps[0].read_text())
+        assert dumped["reason"] == "backend_demoted"
+        assert dumped["worker"] == "fleet:w0"
+        assert dumped["origin"] == "remote"
+        assert dumped["trace_id"] == "fleet/job-1"
+        # the worker-side dump path is preserved, not clobbered by the
+        # door's own
+        assert dumped["worker_path"] == incident["path"]
+        # door-side recorder kept it in memory with its own dump path
+        kept = obs_flight.incidents()
+        assert [i["reason"] for i in kept] == ["backend_demoted"]
+        assert kept[0]["path"] == str(dumps[0])
+    finally:
+        obs_flight.reset()
+
+
+def test_unknown_incident_payload_is_ignored():
+    obs_flight.reset()
+    fleet = _ObsFleet()
+    try:
+        with _door(fleet, workers=1) as door:
+            worker = fleet.workers["fleet:w0"]
+            worker.send(wire.FrameType.INCIDENT, {"incident": "nope"})
+            worker.send(wire.FrameType.STATS, ["not", "a", "dict"])
+            door.submit(_request()).result(timeout=10)
+            stats = door.stats()
+        assert stats["fleet"]["incidents_forwarded"] == 0
+        assert stats["fleet"]["stats_frames"] == 0
+        assert obs_flight.incidents() == []
+    finally:
+        obs_flight.reset()
+
+
+# ---------------------------------------------------------------------
+# zero overhead when the plane is disarmed
+# ---------------------------------------------------------------------
+
+def test_submit_carries_no_trace_when_tracing_disabled():
+    assert not obs_trace.tracing_enabled()
+    assert not obs_metrics.metrics_enabled()
+    fleet = _ObsFleet()
+    with _door(fleet) as door:
+        handles = [door.submit(_request()) for _ in range(4)]
+        for h in handles:
+            h.result(timeout=10)
+        stats = door.stats()
+    submits = [obj for w in fleet.workers.values() for obj in w.submits]
+    assert len(submits) == 4
+    # the key is absent, not present-but-empty
+    assert all("trace" not in obj for obj in submits)
+    # and the spec told the workers to keep their plane disarmed too
+    assert all(not w.spec["trace"] and not w.spec["metrics"]
+               for w in fleet.workers.values())
+    assert stats["fleet"] == {
+        "stats_frames": 0, "incidents_forwarded": 0, "span_events": 0,
+    }
+
+
+def test_real_worker_sends_no_stats_frames_when_disabled(monkeypatch):
+    # a real subprocess worker with the plane disarmed: even with an
+    # aggressive STATS cadence configured, no STATS frame ever arrives
+    # and no span buffer rides the RESULT frames
+    monkeypatch.setenv("WAFFLE_PROC_STATS_S", "0.1")
+    assert not obs_trace.tracing_enabled()
+    assert not obs_metrics.metrics_enabled()
+    cfg = CdwfaConfig(backend="python", min_count=2)
+    req = JobRequest(kind="single", reads=(b"ACGTACGTAC",) * 3,
+                     config=cfg)
+    with ProcFrontDoor(ProcConfig(workers=1, name="dark")) as door:
+        door.submit(req).result(timeout=60)
+        time.sleep(0.5)  # several would-be STATS periods
+        stats = door.stats()
+    assert stats["fleet"] == {
+        "stats_frames": 0, "incidents_forwarded": 0, "span_events": 0,
+    }
+
+
+# ---------------------------------------------------------------------
+# real subprocess: one connected cross-process trace
+# ---------------------------------------------------------------------
+
+def _span_index(spans):
+    return {e["args"]["span_id"]: e for e in spans}
+
+
+def test_subprocess_job_yields_one_connected_cross_process_tree():
+    tracer = obs_trace.get_tracer()
+    tracer.enable(True)
+    tracer.clear()
+    obs_metrics.enable_metrics(True)
+    obs_slo.reset()
+    try:
+        cfg = CdwfaConfig(backend="python", min_count=2)
+        req = JobRequest(kind="single", reads=(b"ACGTACGTAC",) * 3,
+                         config=cfg)
+        with ProcFrontDoor(ProcConfig(workers=1, name="e2e")) as door:
+            handle = door.submit(req)
+            handle.result(timeout=60)
+            stats = door.stats()
+        assert stats["fleet"]["span_events"] > 0
+
+        events = tracer.chrome_events()
+        pid = handle.trace.chrome_pid
+        trace_id = handle.trace.trace_id
+        spans = [
+            e for e in events
+            if e.get("ph") == "X"
+            and e.get("args", {}).get("trace_id") == trace_id
+        ]
+        names = {e["name"] for e in spans}
+        # door-side and worker-side phases on the same timeline
+        assert {"door:job", "door:queued", "serve:job", "search"} <= \
+            names, names
+        # every span renders under the job's own Chrome pid
+        assert {e["pid"] for e in spans} == {pid}
+        # worker-origin spans carry attribution; door-origin ones don't
+        origins = {bool(e["args"].get("worker")) for e in spans}
+        assert origins == {True, False}
+        # parent linkage is closed and single-rooted at door:job
+        by_id = _span_index(spans)
+        roots = [e for e in spans if e["args"]["parent_id"] is None]
+        assert [e["name"] for e in roots] == ["door:job"]
+        for e in spans:
+            parent = e["args"]["parent_id"]
+            assert parent is None or parent in by_id, e
+        # the worker's serve:job parents directly under the door root
+        serve_job = next(e for e in spans if e["name"] == "serve:job")
+        assert serve_job["args"]["parent_id"] == \
+            roots[0]["args"]["span_id"]
+        # flow arrows stitch the socket hop: both directions, and every
+        # finish has a matching start id
+        flows = [e for e in events
+                 if e.get("cat") == "flow" and e.get("pid") == pid]
+        starts = {e["id"] for e in flows if e["ph"] == "s"}
+        finishes = {e["id"] for e in flows if e["ph"] == "f"}
+        assert starts and finishes
+        assert finishes <= starts
+        assert len(starts & finishes) >= 2  # submit hop + result hop
+    finally:
+        tracer.reset_enabled()
+        tracer.clear()
+        obs_metrics.registry().reset()
+        obs_metrics.reset_metrics_enabled()
+        obs_slo.reset()

@@ -432,6 +432,44 @@ def test_health_slow_search_sheds_with_cooldown():
         assert sheds["fake:w1"] == 1
 
 
+def test_health_unknown_reason_counted_not_silent():
+    # forward-compat backstop: a HEALTH reason this door doesn't know
+    # must not change routing state, but it must be visible — a
+    # runtime event plus a reason-labeled counter, never a silent drop
+    from waffle_con_tpu.obs import metrics as obs_metrics
+    from waffle_con_tpu.runtime import events as runtime_events
+
+    obs_metrics.enable_metrics(True)
+    obs_metrics.registry().reset()
+    try:
+        fleet = FakeFleet(triggers={
+            "fake:w0": [{"worker": "fake:w0",
+                         "reason": "reason_from_the_future",
+                         "trace": "fake:w0/job-1", "detail": {}}],
+        })
+        with _door(fleet) as door:
+            deadline = time.monotonic() + 5
+            ignored = []
+            while time.monotonic() < deadline and not ignored:
+                ignored = runtime_events.get_events("door_health_ignored")
+                time.sleep(0.01)
+            assert ignored, "ignored-HEALTH event never recorded"
+            assert ignored[-1]["worker"] == "fake:w0"
+            assert ignored[-1]["reason"] == "reason_from_the_future"
+            # routing state untouched: the worker is still UP and takes
+            # jobs
+            door.submit(_request()).result(timeout=10)
+            states = {w["worker"]: w["state"]
+                      for w in door.worker_stats()}
+            assert states["fake:w0"] == "up"
+        text = obs_metrics.registry().render_prometheus()
+        assert "waffle_door_health_ignored_total" in text
+        assert 'reason="reason_from_the_future"' in text
+    finally:
+        obs_metrics.registry().reset()
+        obs_metrics.reset_metrics_enabled()
+
+
 def test_crashed_worker_requeues_and_single_incident():
     obs_flight.reset()
     fleet = FakeFleet(behaviors={"fake:w0": "crash-after-start"})

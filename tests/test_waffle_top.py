@@ -83,6 +83,41 @@ def _payload():
     }
 
 
+def _fleet_payload():
+    """A ProcFrontDoor payload with the fleet observability plane on:
+    per-worker STATS bookkeeping + the door-level ``fleet`` rollup."""
+    payload = _payload()
+    payload["service"] = "storm"
+    payload["fleet"] = {
+        "stats_frames": 14,
+        "incidents_forwarded": 3,
+        "span_events": 220,
+    }
+    payload["workers"] = [
+        {
+            "worker": "storm:w0", "pid": 4242, "state": "up",
+            "outstanding": 1, "slots": 4, "occupancy": 0.25,
+            "routed": 6, "requeues": 0, "migrations": 0, "restarts": 0,
+            "ckpt_frames": 2, "ckpt_bytes": 4096, "demotions": 0,
+            "sheds": 0, "readmits": 0,
+            "stats_frames": 8, "stats_at": 1699999998.0,
+            "incidents": 3, "span_events": 120,
+            "dispatch_p95_s": 0.018,
+        },
+        {
+            "worker": "storm:w1", "pid": 4243, "state": "up",
+            "outstanding": 0, "slots": 4, "occupancy": 0.0,
+            "routed": 6, "requeues": 0, "migrations": 0, "restarts": 0,
+            "ckpt_frames": 0, "ckpt_bytes": 0, "demotions": 0,
+            "sheds": 0, "readmits": 0,
+            "stats_frames": 6, "stats_at": None,
+            "incidents": 0, "span_events": 100,
+            "dispatch_p95_s": None,
+        },
+    ]
+    return payload
+
+
 def test_render_panels_from_fixture(waffle_top):
     out = waffle_top.render(_payload(), plain=True)
     assert "\x1b[" not in out  # plain mode: no ANSI escapes
@@ -193,3 +228,40 @@ def test_render_worker_process_table(waffle_top):
     lost_row = next(l for l in out.splitlines() if "storm:w1" in l)
     assert " - " in lost_row
     assert "1.00" in out  # occupancy column
+
+
+def test_render_fleet_section(waffle_top):
+    out = waffle_top.render(_fleet_payload(), plain=True)
+    # fleet rollup line: forwarded-frame counters + door-side e2e SLO
+    assert "fleet" in out
+    assert "stats_frames=14" in out
+    assert "incidents_forwarded=3" in out
+    assert "span_events=220" in out
+    assert "e2e p50=800.0ms p95=2.50s" in out
+    # per-worker plane table: snapshot age from the last STATS frame
+    # (unix_time 1700000000 - stats_at 1699999998 = 2.0s), the
+    # worker's own rolling dispatch p95, and "-" placeholders for a
+    # worker that has not shipped a STATS frame yet
+    lines = out.splitlines()
+    fleet_idx = next(
+        i for i, l in enumerate(lines) if l.startswith("fleet ")
+    )
+    w0_row = next(
+        l for l in lines[fleet_idx:] if l.lstrip().startswith("storm:w0")
+    )
+    w1_row = next(
+        l for l in lines[fleet_idx:] if l.lstrip().startswith("storm:w1")
+    )
+    assert "2.0s" in w0_row and "18.0ms" in w0_row and "120" in w0_row
+    assert w1_row.split() == ["storm:w1", "-", "6", "0", "100", "-"]
+
+
+def test_render_fleet_section_absent_without_fleet_field(waffle_top):
+    # a pre-fleet door payload (workers but no "fleet") must render the
+    # worker table only — no fleet rollup, no crash
+    payload = _fleet_payload()
+    del payload["fleet"]
+    out = waffle_top.render(payload, plain=True)
+    assert "worker processes (2)" in out
+    assert "stats_frames=" not in out
+    assert "incidents_forwarded=" not in out

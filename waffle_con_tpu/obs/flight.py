@@ -151,13 +151,10 @@ class FlightRecorder:
 
     # -- anomaly path --------------------------------------------------
 
-    def trigger(self, reason: str, trace_id: Optional[str] = None,
-                **detail) -> Optional[Dict]:
-        """Fire an anomaly trigger: assemble an incident (and dump it to
-        ``WAFFLE_FLIGHT_DIR`` when set).  Returns the incident dict, or
-        ``None`` when ``(reason, trace_id)`` fired within the dedupe
-        window (``WAFFLE_FLIGHT_DEDUPE_S``, default 300 s; expired
-        entries re-fire so recurring incidents stay visible)."""
+    def _admit(self, reason: str,
+               trace_id: Optional[str]) -> Optional[int]:
+        """Dedupe on ``(reason, trace_id)`` and allocate a sequence
+        number; ``None`` means suppressed within the rolling window."""
         key = (reason, trace_id)
         window = (
             self._dedupe_s if self._dedupe_s is not None
@@ -177,8 +174,12 @@ class FlightRecorder:
                     if now - t < window
                 }
             self._seq += 1
-            seq = self._seq
-        incident = self._build_incident(seq, reason, trace_id, detail)
+            return self._seq
+
+    def _dump_and_keep(self, incident: Dict, seq: int,
+                       reason: str) -> Dict:
+        """Write the incident to ``WAFFLE_FLIGHT_DIR`` (atomic rename,
+        when set) and append it to the in-memory list."""
         dump_dir = envspec.get_raw("WAFFLE_FLIGHT_DIR", "")
         if dump_dir:
             try:
@@ -199,6 +200,50 @@ class FlightRecorder:
             self._incidents.append(incident)
             del self._incidents[:-MAX_INCIDENTS]
         return incident
+
+    def trigger(self, reason: str, trace_id: Optional[str] = None,
+                **detail) -> Optional[Dict]:
+        """Fire an anomaly trigger: assemble an incident (and dump it to
+        ``WAFFLE_FLIGHT_DIR`` when set).  Returns the incident dict, or
+        ``None`` when ``(reason, trace_id)`` fired within the dedupe
+        window (``WAFFLE_FLIGHT_DEDUPE_S``, default 300 s; expired
+        entries re-fire so recurring incidents stay visible)."""
+        seq = self._admit(reason, trace_id)
+        if seq is None:
+            return None
+        incident = self._build_incident(seq, reason, trace_id, detail)
+        return self._dump_and_keep(incident, seq, reason)
+
+    def ingest_remote(self, incident: Dict,
+                      worker: Optional[str] = None) -> Optional[Dict]:
+        """Re-ingest an incident built by ANOTHER process's recorder
+        (a worker's INCIDENT frame): run this side's
+        ``(reason, trace_id)`` dedupe at fleet scope, re-stamp the
+        sequence number, attribute the originating worker, and dump via
+        the normal path.  Returns the ingested incident, or ``None``
+        when suppressed (or the payload is not an incident object)."""
+        if not isinstance(incident, dict):
+            return None
+        reason = str(incident.get("reason") or "unknown")
+        trace_id = incident.get("trace_id")
+        if trace_id is not None:
+            trace_id = str(trace_id)
+        seq = self._admit(reason, trace_id)
+        if seq is None:
+            return None
+        ingested = dict(incident)
+        ingested["seq"] = seq
+        ingested["reason"] = reason
+        ingested["origin"] = "remote"
+        ingested["ingested_unix_time"] = time.time()
+        if worker is not None:
+            ingested["worker"] = worker
+        # the shipped path (if any) names a file in the WORKER's dump
+        # dir; keep it as provenance and let _dump_and_keep set this
+        # side's path
+        if "path" in ingested:
+            ingested["worker_path"] = ingested.pop("path")
+        return self._dump_and_keep(ingested, seq, reason)
 
     def _build_incident(self, seq: int, reason: str,
                         trace_id: Optional[str], detail: Dict) -> Dict:
@@ -268,6 +313,37 @@ def _notify_listeners(reason: str, trace_id: Optional[str],
             pass
 
 
+#: incident listeners: called with the fully-built incident dict AFTER
+#: dedupe admitted it — the proc worker forwards these to the door as
+#: INCIDENT frames (one frame per unique incident, not per occurrence).
+_INCIDENT_LISTENERS: List = []
+
+
+def add_incident_listener(fn) -> None:
+    """Register ``fn(incident)`` on every post-dedupe built incident."""
+    with _LISTENER_LOCK:
+        if fn not in _INCIDENT_LISTENERS:
+            _INCIDENT_LISTENERS.append(fn)
+
+
+def remove_incident_listener(fn) -> None:
+    with _LISTENER_LOCK:
+        try:
+            _INCIDENT_LISTENERS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _notify_incident_listeners(incident: Dict) -> None:
+    with _LISTENER_LOCK:
+        listeners = list(_INCIDENT_LISTENERS)
+    for fn in listeners:
+        try:
+            fn(incident)
+        except Exception:  # noqa: BLE001 - listeners must never break
+            pass
+
+
 def get_recorder() -> FlightRecorder:
     return _RECORDER
 
@@ -279,7 +355,16 @@ def record(kind: str, /, trace_id: Optional[str] = None, **fields) -> None:
 def trigger(reason: str, trace_id: Optional[str] = None,
             **detail) -> Optional[Dict]:
     _notify_listeners(reason, trace_id, detail)
-    return _RECORDER.trigger(reason, trace_id=trace_id, **detail)
+    incident = _RECORDER.trigger(reason, trace_id=trace_id, **detail)
+    if incident is not None:
+        _notify_incident_listeners(incident)
+    return incident
+
+
+def ingest_remote(incident: Dict,
+                  worker: Optional[str] = None) -> Optional[Dict]:
+    """Module-level :meth:`FlightRecorder.ingest_remote` passthrough."""
+    return _RECORDER.ingest_remote(incident, worker=worker)
 
 
 def incidents() -> List[Dict]:

@@ -23,6 +23,7 @@ created and the engines' per-search cost is a handful of boolean checks.
 from __future__ import annotations
 
 import bisect
+import re
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from waffle_con_tpu.analysis import lockcheck
@@ -154,6 +155,16 @@ def _format_labels(key: _LabelKey, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def _parse_labels(label_str: str) -> Dict[str, str]:
+    """Inverse of :func:`_format_labels` for the label values this
+    registry actually emits (identifiers, backend names, service names —
+    never embedded quotes)."""
+    return dict(_LABEL_RE.findall(label_str or ""))
+
+
 class MetricsRegistry:
     """Thread-safe named-metric store with labelled children.
 
@@ -223,6 +234,63 @@ class MetricsRegistry:
     def reset(self) -> None:
         with self._lock:
             self._families.clear()
+
+    # -- federation ----------------------------------------------------
+
+    def merge_snapshot(self, snap: Dict, **extra_labels) -> int:
+        """Re-ingest another process's :meth:`snapshot` under added
+        labels — the proc front door merges each worker's periodic
+        STATS snapshot with ``worker=<name>`` so one exposition covers
+        the fleet.
+
+        Remote snapshots are cumulative, so children are **set** to the
+        shipped values (last-write-wins per worker), not incremented.
+        Malformed or type-colliding families are skipped, never raised:
+        a worker snapshot must not be able to kill the door's read
+        loop.  Returns the number of series merged.
+        """
+        if not isinstance(snap, dict):
+            return 0
+        merged = 0
+        for name, family in snap.items():
+            if not isinstance(family, dict):
+                continue
+            kind = family.get("type")
+            series = family.get("series")
+            if kind not in ("counter", "gauge", "histogram") \
+                    or not isinstance(series, dict):
+                continue
+            for label_str, value in series.items():
+                labels = _parse_labels(str(label_str))
+                labels.update(extra_labels)
+                try:
+                    if kind == "histogram":
+                        buckets = value.get("buckets", {})
+                        ordered = sorted(
+                            ((float(b), int(c))
+                             for b, c in buckets.items()),
+                        )
+                        if not ordered:
+                            continue
+                        child = self._child(
+                            "histogram", name, labels,
+                            bounds=[b for b, _c in ordered],
+                        )
+                        with child._lock:
+                            child.counts = (
+                                [c for _b, c in ordered]
+                                + [int(value.get("overflow", 0))]
+                            )
+                            child.sum = float(value.get("sum", 0.0))
+                            child.count = int(value.get("count", 0))
+                    else:
+                        child = self._child(kind, name, labels)
+                        with child._lock:
+                            child.value = float(value)
+                    merged += 1
+                except (ValueError, TypeError, AttributeError):
+                    continue
+        return merged
 
     # -- exposition ----------------------------------------------------
 

@@ -88,7 +88,7 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "parity", "procs", "jobs_per_s", "jobs_per_s_single",
         "speedup_vs_single", "p95_job_latency_s", "p99_job_latency_s",
         "per_worker", "workers_participating", "requeues",
-        "worker_lost_incidents", "mesh_placed",
+        "worker_lost_incidents", "mesh_placed", "fleet",
     ),
     # the crash drill (--kill-worker) is its own mode: migration
     # accounting fields on top of the storm-procs shape, and a mode
@@ -97,6 +97,7 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "parity", "procs", "jobs_per_s", "per_worker",
         "worker_lost_incidents", "checkpoints", "migrated",
         "restarted_started", "wasted_work_s", "migration_jobs",
+        "fleet",
     ),
     "microbench": ("parity", "steps", "stop_code", "breakdown"),
     "north-star": ("parity", "vs_baseline", "breakdown"),

@@ -83,18 +83,27 @@ class TraceContext:
     the single-runner safety contract).
     """
 
-    __slots__ = ("trace_id", "chrome_pid", "label", "_stack", "_next_id")
+    __slots__ = ("trace_id", "chrome_pid", "label", "root_parent",
+                 "_stack", "_next_id")
 
-    def __init__(self, trace_id: str, chrome_pid: int, label: str = "") -> None:
+    def __init__(self, trace_id: str, chrome_pid: int, label: str = "",
+                 span_base: int = 0,
+                 root_parent: Optional[int] = None) -> None:
         self.trace_id = trace_id
         self.chrome_pid = int(chrome_pid)
         self.label = label or trace_id
+        #: parent span id for stack-root spans — set on contexts adopted
+        #: from another process so the remote tree nests under the
+        #: originating side's per-job root span
+        self.root_parent = root_parent
         self._stack: List[int] = []
-        self._next_id = 0
+        #: span ids count up from here — adopted contexts get a disjoint
+        #: base so ids never collide with the minting process's spans
+        self._next_id = int(span_base)
 
     def _open_span(self) -> "tuple[int, Optional[int]]":
         """Allocate a span id, returning ``(span_id, parent_id)``."""
-        parent = self._stack[-1] if self._stack else None
+        parent = self._stack[-1] if self._stack else self.root_parent
         self._next_id += 1
         span_id = self._next_id
         self._stack.append(span_id)
@@ -114,6 +123,39 @@ class TraceContext:
 #: Chrome pids for job contexts start here so they can never collide
 #: with a real process pid on the same timeline
 JOB_PID_BASE = 1_000_000
+
+
+def context_to_wire(ctx: TraceContext,
+                    parent_span_id: Optional[int] = None,
+                    span_base: int = 0,
+                    flow_id: Optional[int] = None) -> Dict:
+    """A :class:`TraceContext` as the plain-JSON dict the proc wire
+    ships on SUBMIT (see ``serve.procs.wire.decode_trace`` for the
+    receiving-side validation)."""
+    return {
+        "trace_id": ctx.trace_id,
+        "chrome_pid": ctx.chrome_pid,
+        "label": ctx.label,
+        "parent_span_id": parent_span_id,
+        "span_base": int(span_base),
+        "flow_id": flow_id,
+    }
+
+
+def context_from_wire(obj: Dict) -> TraceContext:
+    """Rebuild an adopted :class:`TraceContext` from a wire dict: same
+    trace id and Chrome pid as the minting process, span ids allocated
+    from the shipped disjoint base, stack-root spans parented under the
+    minting side's per-job root span."""
+    return TraceContext(
+        str(obj["trace_id"]),
+        int(obj["chrome_pid"]),
+        label=str(obj.get("label") or ""),
+        span_base=int(obj.get("span_base") or 0),
+        root_parent=(int(obj["parent_span_id"])
+                     if obj.get("parent_span_id") is not None else None),
+    )
+
 
 _CTX = threading.local()
 
@@ -270,14 +312,18 @@ class Tracer:
             self._events.append(event)
             self._totals[span.cat] = self._totals.get(span.cat, 0.0) + dt
 
-    def flow(self, phase: str, flow_id: int, name: str = "coalesce") -> None:
+    def flow(self, phase: str, flow_id: int, name: str = "coalesce",
+             ctx: Optional[TraceContext] = None) -> None:
         """Append a Chrome flow event (``phase`` ``"s"`` start on the
         submitting thread, ``"f"`` finish on the executing thread) so the
-        worker→dispatcher hop renders as an arrow in Perfetto.  No-op
-        when tracing is disabled."""
+        worker→dispatcher hop renders as an arrow in Perfetto.  ``ctx``
+        overrides the thread-local context (the proc door/worker emit
+        socket-hop arrows from threads that never activate the job's
+        context).  No-op when tracing is disabled."""
         if not self.enabled:
             return
-        ctx = current_context()
+        if ctx is None:
+            ctx = current_context()
         event = {
             "name": name,
             "cat": "flow",
@@ -291,6 +337,113 @@ class Tracer:
             event["bp"] = "e"  # bind finish to enclosing slice
         with self._lock:
             self._events.append(event)
+
+    def record_span(self, ctx: TraceContext, name: str, cat: str,
+                    start_mono_s: float, end_mono_s: float,
+                    span_id: Optional[int] = None,
+                    parent_id: Optional[int] = None, **args) -> None:
+        """Append one retrospective complete event under ``ctx``.
+
+        The proc front door uses this for phases it only knows after
+        the fact (queue wait, the whole door-side job envelope):
+        ``start_mono_s``/``end_mono_s`` are ``time.monotonic()``
+        readings, mapped onto this tracer's event clock via a paired
+        now-sample of both clocks.  No-op when tracing is disabled.
+        """
+        if not self.enabled:
+            return
+        now_us = (time.perf_counter_ns() - self._t0_ns) / 1e3
+        now_mono = time.monotonic()
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": now_us - (now_mono - start_mono_s) * 1e6,
+            "dur": max(0.0, (end_mono_s - start_mono_s) * 1e6),
+            "pid": ctx.chrome_pid,
+            "tid": threading.get_ident() % 2**31,
+            "args": dict(args, trace_id=ctx.trace_id, span_id=span_id,
+                         parent_id=parent_id),
+        }
+        dt = max(0.0, end_mono_s - start_mono_s)
+        with self._lock:
+            if ctx.chrome_pid not in self._named_pids:
+                self._named_pids.add(ctx.chrome_pid)
+                self._events.append({
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": ctx.chrome_pid,
+                    "args": {"name": ctx.label},
+                })
+            self._events.append(event)
+            self._totals[cat] = self._totals.get(cat, 0.0) + dt
+
+    # -- cross-process stitching ---------------------------------------
+
+    def unix_origin_us(self) -> float:
+        """Unix-epoch microseconds at ``ts == 0`` on this tracer's event
+        clock — shipped alongside drained span buffers so another
+        process can rebase them onto its own timeline."""
+        return time.time() * 1e6 - (
+            time.perf_counter_ns() - self._t0_ns
+        ) / 1e3
+
+    def drain_events(self, pid: int,
+                     limit: Optional[int] = None) -> List[Dict]:
+        """Remove and return this tracer's events for one Chrome pid
+        (a served job's synthetic pid) — the worker-side span buffer a
+        RESULT/ERROR/CHECKPOINT frame carries back to the door.
+
+        Process-name metadata stays behind (the door names the pid from
+        its own side).  ``limit`` keeps only the **latest** events:
+        span completion order is children-first, so the tail is where
+        the enclosing spans (and the job root) live.
+        """
+        kept: List[Dict] = []
+        out: List[Dict] = []
+        with self._lock:
+            for event in self._events:
+                if event.get("pid") == pid and event.get("ph") != "M":
+                    out.append(event)
+                else:
+                    kept.append(event)
+            self._events[:] = kept
+        if limit is not None and limit >= 0 and len(out) > limit:
+            out = out[len(out) - limit:]
+        return out
+
+    def ingest_remote_events(self, events: List[Dict],
+                             origin_us: Optional[float] = None,
+                             worker: Optional[str] = None) -> int:
+        """Merge another process's drained span events into this tracer,
+        rebasing their timestamps onto this tracer's clock (each process
+        measures ``ts`` from its own epoch; ``origin_us`` is the remote
+        :meth:`unix_origin_us`).  Returns the number of events kept."""
+        if not self.enabled or not events:
+            return 0
+        shift = 0.0
+        if origin_us is not None:
+            try:
+                shift = float(origin_us) - self.unix_origin_us()
+            except (TypeError, ValueError):
+                shift = 0.0
+        stitched: List[Dict] = []
+        for event in events:
+            if not isinstance(event, dict):
+                continue
+            event = dict(event)
+            try:
+                event["ts"] = float(event.get("ts", 0.0)) + shift
+            except (TypeError, ValueError):
+                continue
+            if worker:
+                args = dict(event.get("args") or {})
+                args.setdefault("worker", worker)
+                event["args"] = args
+            stitched.append(event)
+        with self._lock:
+            self._events.extend(stitched)
+        return len(stitched)
 
     # -- export --------------------------------------------------------
 

@@ -46,7 +46,17 @@ The door owns everything the workers must agree on exactly once:
 * **observability** — ``waffle_worker_*`` and ``waffle_ckpt_*``
   gauges/counters, a ``workers`` table in the ``WAFFLE_STATS_FILE``
   payload (the door is the only stats publisher; workers run with
-  stats disabled), runtime events for every transition.
+  stats disabled), runtime events for every transition.  With the
+  fleet observability plane armed, the door additionally (a) mints a
+  per-job :class:`~waffle_con_tpu.obs.trace.TraceContext` on each
+  SUBMIT and stitches the worker's returned span buffer into one
+  connected Chrome trace (flow arrows across the socket hop), (b)
+  merges each worker's periodic ``STATS`` metrics snapshot into its
+  own registry under a ``worker=`` label — a single fleet-wide
+  Prometheus exposition — plus ``waffle_door_job_phase_seconds``
+  histograms splitting e2e latency by queued/routed/running phase,
+  and (c) re-ingests forwarded ``INCIDENT`` frames into its flight
+  recorder with worker attribution and fleet-level dedupe.
 
 Client-side cancellation settles the door-side handle immediately;
 the worker keeps computing until its own dispatch-boundary abort and
@@ -70,6 +80,7 @@ from waffle_con_tpu.analysis import lockcheck
 from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import slo as obs_slo
+from waffle_con_tpu.obs import trace as obs_trace
 from waffle_con_tpu.runtime import events
 from waffle_con_tpu.runtime.liveness import Heartbeats, WorkerLost
 from waffle_con_tpu.runtime.watchdog import DeadlineExceeded
@@ -176,7 +187,9 @@ class _Worker:
                  "state", "shed_until", "assigned", "started",
                  "routed", "demotions", "sheds", "readmits", "requeues",
                  "migrations", "restarts", "ckpt_frames", "ckpt_bytes",
-                 "reported_outstanding", "decoder", "send_lock")
+                 "reported_outstanding", "decoder", "send_lock",
+                 "stats_frames", "stats_at", "last_slo", "incidents",
+                 "span_events")
 
     def __init__(self, index: int, name: str) -> None:
         self.index = index
@@ -201,6 +214,11 @@ class _Worker:
         self.reported_outstanding = 0
         self.decoder = wire.FrameDecoder()
         self.send_lock = lockcheck.make_lock(f"procs.door.send.{name}")
+        self.stats_frames = 0
+        self.stats_at: Optional[float] = None
+        self.last_slo: Optional[Dict] = None
+        self.incidents = 0
+        self.span_events = 0
 
 
 class ProcFrontDoor:
@@ -233,6 +251,13 @@ class ProcFrontDoor:
             aging_s=self.config.aging_s,
         )
         self._beats = Heartbeats()
+        #: per-job distributed-trace state ({"root", "dispatches",
+        #: "flow"}) keyed by job id; entries exist only while tracing is
+        #: enabled and the job is in flight (see _trace_dispatch)
+        self._trace_jobs: Dict[int, Dict] = {}
+        #: monotonic timestamp of each job's last successful SUBMIT send
+        #: — feeds the queued/routed/running phase histograms
+        self._routed_at: Dict[int, float] = {}
         self._stats_published_at = 0.0
         self._stopping = False
         self._tmpdir = tempfile.mkdtemp(prefix="waffle-procs-")
@@ -261,6 +286,11 @@ class ProcFrontDoor:
             "max_batch": cfg.max_batch,
             "adaptive_window": cfg.adaptive_window,
             "aging_s": cfg.aging_s,
+            # programmatic enables don't travel via the environment:
+            # tell the worker to arm its own tracer / metrics registry
+            # so spans and STATS frames flow back to the door
+            "trace": obs_trace.tracing_enabled(),
+            "metrics": obs_metrics.metrics_enabled(),
         })
 
     @staticmethod
@@ -268,6 +298,13 @@ class ProcFrontDoor:
         env = dict(os.environ)
         # the door is the only stats publisher
         env.pop("WAFFLE_STATS_FILE", None)
+        # with incident forwarding on the door is also the only
+        # incident dumper: the worker forwards its flight dump over the
+        # INCIDENT frame and the door re-ingests it with attribution —
+        # a worker writing the same incident to the shared dump dir
+        # would double every file
+        if envspec.get_raw("WAFFLE_PROC_INCIDENTS", "1") not in ("", "0"):
+            env.pop("WAFFLE_FLIGHT_DIR", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))))
         env["PYTHONPATH"] = (
@@ -664,6 +701,9 @@ class ProcFrontDoor:
             # never decodes it (the worker validates CRC/version and
             # degrades to a fresh search on rejection)
             payload["checkpoint"] = checkpoint
+        trace_obj = self._trace_dispatch(handle)
+        if trace_obj is not None:
+            payload["trace"] = trace_obj
         try:
             try:
                 frame = wire.encode_frame(wire.FrameType.SUBMIT, payload)
@@ -684,6 +724,9 @@ class ProcFrontDoor:
         try:
             with worker.send_lock:
                 worker.sock.sendall(frame)
+            if obs_metrics.metrics_enabled():
+                with self._lock:
+                    self._routed_at[handle.job_id] = time.monotonic()
             return True
         except OSError:
             with self._lock:
@@ -704,6 +747,117 @@ class ProcFrontDoor:
                 worker.sock.sendall(frame)
         except OSError:
             pass  # the reader/watchdog will declare the worker lost
+
+    # -- distributed tracing -------------------------------------------
+
+    def _trace_dispatch(self, handle: JobHandle) -> Optional[Dict]:
+        """Mint this dispatch's wire trace context (``None`` — and zero
+        work — with tracing disabled, so the SUBMIT frame stays
+        byte-identical to the untraced protocol).
+
+        First dispatch opens the job's door-side **root** span (held
+        open until :meth:`_trace_settle`) and records the retrospective
+        ``door:queued`` phase under it.  Every dispatch emits the
+        submit-hop flow arrow (``"s"`` here, ``"f"`` in the worker) and
+        ships a dispatch-disjoint ``span_base`` so a migrated job's
+        second worker can never collide span ids with the first.
+        """
+        tracer = obs_trace.get_tracer()
+        if not tracer.enabled:
+            return None
+        ctx = handle.trace
+        queued_span = None
+        now = time.monotonic()
+        with self._lock:
+            state = self._trace_jobs.get(handle.job_id)
+            if state is None:
+                root, _ = ctx._open_span()  # closed by _trace_settle
+                qid, qparent = ctx._open_span()
+                ctx._close_span(qid)
+                state = {"root": root, "dispatches": 0, "flow": 0}
+                self._trace_jobs[handle.job_id] = state
+                queued_span = (qid, qparent)
+            state["dispatches"] += 1
+            n = state["dispatches"]
+            # 16 flow ids per job pid: 8 dispatch attempts x (submit
+            # arrow, result arrow) before ids recycle
+            fid = ctx.chrome_pid * 16 + (n & 7) * 2
+            state["flow"] = fid
+            root = state["root"]
+        if queued_span is not None:
+            tracer.record_span(
+                ctx, "door:queued", "door", handle.submitted_at, now,
+                span_id=queued_span[0], parent_id=queued_span[1],
+            )
+        tracer.flow("s", fid, "submit", ctx=ctx)
+        return obs_trace.context_to_wire(
+            ctx, parent_span_id=root, span_base=1_000_000 * n,
+            flow_id=fid,
+        )
+
+    def _trace_settle(self, handle: JobHandle, status: str) -> None:
+        """Close out the job's door-side trace: the result-hop flow
+        arrow (finishing the worker's ``"s"``) and the ``door:job``
+        envelope span the whole stitched tree hangs under."""
+        with self._lock:
+            state = self._trace_jobs.pop(handle.job_id, None)
+        tracer = obs_trace.get_tracer()
+        if state is None or not tracer.enabled:
+            return
+        ctx = handle.trace
+        tracer.flow("f", state["flow"] + 1, "result", ctx=ctx)
+        end = handle.finished_at
+        if end is None:
+            end = time.monotonic()
+        tracer.record_span(
+            ctx, "door:job", "door", handle.submitted_at, end,
+            span_id=state["root"], parent_id=None,
+            status=status, dispatches=state["dispatches"],
+        )
+        ctx._close_span(state["root"])
+
+    def _ingest_spans(self, worker: _Worker, obj: Dict) -> None:
+        """Stitch a frame's piggybacked worker span buffer into the
+        door's tracer (rebasing onto the door's clock)."""
+        spans = obj.get("spans") if isinstance(obj, dict) else None
+        if not isinstance(spans, dict):
+            return
+        events_list = spans.get("events")
+        if not isinstance(events_list, list):
+            return
+        n = obs_trace.get_tracer().ingest_remote_events(
+            events_list, origin_us=spans.get("origin_us"),
+            worker=worker.name,
+        )
+        if n:
+            with self._lock:
+                worker.span_events += n
+
+    def _observe_phases(self, handle: JobHandle) -> None:
+        """E2e latency split by door phase — ``queued`` (admission to
+        SUBMIT send), ``routed`` (send to worker STARTED), ``running``
+        (STARTED to terminal) — as one labelled histogram family."""
+        with self._lock:
+            routed_at = self._routed_at.pop(handle.job_id, None)
+        if not obs_metrics.metrics_enabled():
+            return
+        finished = handle.finished_at
+        started = handle.started_at
+        phases = []
+        if routed_at is not None:
+            phases.append(("queued", routed_at - handle.submitted_at))
+            if started is not None:
+                phases.append(("routed", started - routed_at))
+        if started is not None and finished is not None:
+            phases.append(("running", finished - started))
+        reg = obs_metrics.registry()
+        for phase, seconds in phases:
+            if seconds < 0:
+                continue
+            reg.histogram(
+                "waffle_door_job_phase_seconds",
+                service=self.config.name, phase=phase,
+            ).observe(seconds)
 
     # -- worker frames -------------------------------------------------
 
@@ -743,6 +897,10 @@ class ProcFrontDoor:
             self._apply_health(worker, obj)
         elif ftype is wire.FrameType.CHECKPOINT:
             self._on_checkpoint(worker, obj)
+        elif ftype is wire.FrameType.STATS:
+            self._on_stats(worker, obj)
+        elif ftype is wire.FrameType.INCIDENT:
+            self._on_incident(worker, obj)
         elif ftype is wire.FrameType.PONG:
             with self._lock:
                 worker.reported_outstanding = int(
@@ -750,10 +908,54 @@ class ProcFrontDoor:
                 )
         # HELLO repeats and unknown-but-valid frames are ignored
 
+    def _on_stats(self, worker: _Worker, obj: Any) -> None:
+        """Federate one worker's periodic STATS frame: merge its
+        metrics snapshot into the door registry under a ``worker=``
+        label (one fleet-wide exposition) and keep its latest SLO
+        windows for the stats payload / ``waffle_top`` fleet view."""
+        if not isinstance(obj, dict):
+            return
+        slo = obj.get("slo")
+        with self._lock:
+            worker.stats_frames += 1
+            worker.stats_at = time.time()
+            if isinstance(slo, dict):
+                worker.last_slo = slo
+        metrics_snap = obj.get("metrics")
+        if obs_metrics.metrics_enabled() and isinstance(metrics_snap, dict):
+            obs_metrics.registry().merge_snapshot(
+                metrics_snap, worker=worker.name
+            )
+        self._publish_stats()
+
+    def _on_incident(self, worker: _Worker, obj: Any) -> None:
+        """Aggregate a worker-side flight incident: re-ingest it into
+        the door's recorder (fleet-level dedupe, ``WAFFLE_FLIGHT_DIR``
+        dump with worker attribution) and record the event."""
+        incident = obj.get("incident") if isinstance(obj, dict) else None
+        if not isinstance(incident, dict):
+            return
+        with self._lock:
+            worker.incidents += 1
+        stored = obs_flight.ingest_remote(incident, worker=worker.name)
+        events.record(
+            "worker_incident", worker=worker.name,
+            reason=incident.get("reason"),
+            trace_id=incident.get("trace_id"),
+            deduped=stored is None,
+        )
+        if obs_metrics.metrics_enabled():
+            obs_metrics.registry().counter(
+                "waffle_door_worker_incidents_total",
+                service=self.config.name, worker=worker.name,
+            ).inc()
+        self._publish_stats()
+
     def _on_checkpoint(self, worker: _Worker, obj: Any) -> None:
         """Store the worker's latest snapshot on the door-side handle
         (verbatim, never decoded) — the resume point migration and
         deadline persistence run on."""
+        self._ingest_spans(worker, obj)
         try:
             job_id = int(obj["job"])
             data = obj["data"]
@@ -779,6 +981,7 @@ class ProcFrontDoor:
             return worker.assigned.pop(job_id, None)
 
     def _on_result(self, worker: _Worker, obj: Dict) -> None:
+        self._ingest_spans(worker, obj)
         handle = self._take_assigned(worker, int(obj["job"]))
         if handle is None:
             return
@@ -786,14 +989,19 @@ class ProcFrontDoor:
             result = wire.decode_result(obj["kind"], obj["result"])
         except wire.WireError as exc:
             handle._finish(JobStatus.FAILED, exception=exc)
+            self._trace_settle(handle, "failed")
+            self._observe_phases(handle)
             return
         handle._finish(JobStatus.DONE, result=result)
         if handle.latency_s is not None:
             obs_slo.observe_job(handle.latency_s)
+        self._trace_settle(handle, "done")
+        self._observe_phases(handle)
         self._publish_worker_metrics(worker)
         self._publish_stats()
 
     def _on_error(self, worker: _Worker, obj: Dict) -> None:
+        self._ingest_spans(worker, obj)
         handle = self._take_assigned(worker, int(obj["job"]))
         if handle is None:
             return
@@ -819,6 +1027,8 @@ class ProcFrontDoor:
                     f"{obj.get('type', 'Error')}: {message}"
                 ),
             )
+        self._trace_settle(handle, kind)
+        self._observe_phases(handle)
         self._publish_stats()
 
     # -- health --------------------------------------------------------
@@ -828,6 +1038,19 @@ class ProcFrontDoor:
         attribution is the connection itself (no trace parsing)."""
         reason = obj.get("reason")
         if reason not in _HEALTH_REASONS:
+            # unknown reasons are the forward-compat backstop for newer
+            # workers — ignored for routing, but never silently: the
+            # counter + event make a version-skewed fleet visible
+            events.record(
+                "door_health_ignored", worker=worker.name,
+                reason=str(reason), trace_id=obj.get("trace"),
+            )
+            if obs_metrics.metrics_enabled():
+                obs_metrics.registry().counter(
+                    "waffle_door_health_ignored_total",
+                    service=self.config.name, worker=worker.name,
+                    reason=str(reason),
+                ).inc()
             return
         with self._lock:
             if self._closed or worker.state == LOST:
@@ -1043,6 +1266,14 @@ class ProcFrontDoor:
                     "demotions": worker.demotions,
                     "sheds": worker.sheds,
                     "readmits": worker.readmits,
+                    "stats_frames": worker.stats_frames,
+                    "stats_at": worker.stats_at,
+                    "incidents": worker.incidents,
+                    "span_events": worker.span_events,
+                    "dispatch_p95_s": (
+                        (worker.last_slo.get("dispatch") or {}).get("p95_s")
+                        if isinstance(worker.last_slo, dict) else None
+                    ),
                 })
         return out
 
@@ -1050,9 +1281,13 @@ class ProcFrontDoor:
         """Aggregated counters plus the per-worker table."""
         with self._lock:
             # fold terminal handles into the cumulative counts, then
-            # drop them so the jobs dict stays bounded
+            # drop them so the jobs dict stays bounded (trace/phase
+            # state for jobs that settled off the happy path — orphans,
+            # worker-lost failures — is purged alongside)
             for job_id in [j for j, h in self._jobs.items() if h.done()]:
                 self._counts[self._jobs.pop(job_id).status.value] += 1
+                self._trace_jobs.pop(job_id, None)
+                self._routed_at.pop(job_id, None)
             counts = dict(self._counts)
         workers = self.worker_stats()
         return {
@@ -1065,6 +1300,13 @@ class ProcFrontDoor:
                 "bytes": sum(w["ckpt_bytes"] for w in workers),
                 "migrations": sum(w["migrations"] for w in workers),
                 "restarts": sum(w["restarts"] for w in workers),
+            },
+            "fleet": {
+                "stats_frames": sum(w["stats_frames"] for w in workers),
+                "incidents_forwarded": sum(
+                    w["incidents"] for w in workers
+                ),
+                "span_events": sum(w["span_events"] for w in workers),
             },
         }
 
@@ -1086,6 +1328,7 @@ class ProcFrontDoor:
             "unix_time": time.time(),
             "stats": stats,
             "workers": stats["workers"],
+            "fleet": stats["fleet"],
             "slo": obs_slo.snapshot(),
             "incidents": [
                 {k: i.get(k) for k in

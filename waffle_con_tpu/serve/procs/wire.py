@@ -59,6 +59,15 @@ class FrameType(enum.IntEnum):
                      #: an opaque search-checkpoint wire dict (see
                      #: :mod:`waffle_con_tpu.models.checkpoint`); the
                      #: door stores it verbatim and never decodes it
+    STATS = 12       #: worker -> door: periodic {worker, unix_time,
+                     #: metrics, slo, incidents} — ``metrics`` is the
+                     #: worker's ``MetricsRegistry.snapshot()``, merged
+                     #: door-side under ``worker=<name>`` labels; only
+                     #: sent when metrics are enabled in the worker
+    INCIDENT = 13    #: worker -> door: {worker, incident} — the full
+                     #: flight-recorder incident JSON, re-ingested into
+                     #: the door's recorder with worker attribution and
+                     #: fleet-level (reason, trace_id) dedupe
 
 
 class WireError(RuntimeError):
@@ -166,6 +175,44 @@ def _unb64(text: str) -> bytes:
         return base64.b64decode(text.encode("ascii"), validate=True)
     except Exception as exc:
         raise WireError(f"bad base64 field: {exc}") from None
+
+
+# -- trace-context codec -----------------------------------------------
+
+def decode_trace(obj: Optional[Dict]) -> Optional[Dict]:
+    """Validate the optional SUBMIT trace context.
+
+    The door mints each job's :class:`~waffle_con_tpu.obs.trace.TraceContext`
+    and ships ``{trace_id, chrome_pid, label, parent_span_id, span_base,
+    flow_id}`` so the worker's spans join the same Chrome trace tree
+    (same synthetic pid, span ids allocated from a disjoint base, root
+    spans parented under the door's per-job root span).  ``None``
+    passes through (tracing disabled on the door); anything malformed
+    is a typed :class:`WireError` — the worker treats that as "no
+    context", never a failed job.
+    """
+    if obj is None:
+        return None
+    if not isinstance(obj, dict):
+        raise WireError("trace context must be an object")
+    try:
+        out = {
+            "trace_id": str(obj["trace_id"]),
+            "chrome_pid": int(obj["chrome_pid"]),
+            "label": str(obj.get("label") or ""),
+            "parent_span_id": (
+                int(obj["parent_span_id"])
+                if obj.get("parent_span_id") is not None else None
+            ),
+            "span_base": int(obj.get("span_base") or 0),
+            "flow_id": (int(obj["flow_id"])
+                        if obj.get("flow_id") is not None else None),
+        }
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WireError(f"bad trace context: {exc}") from None
+    if out["chrome_pid"] < 0 or out["span_base"] < 0:
+        raise WireError("trace context ids must be non-negative")
+    return out
 
 
 # -- config codec ------------------------------------------------------

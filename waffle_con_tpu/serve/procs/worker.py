@@ -25,6 +25,19 @@ Protocol (see :mod:`waffle_con_tpu.serve.procs.wire`):
 * every local flight-recorder trigger is forwarded as a ``HEALTH``
   frame so the door can attribute demotions and slow searches to this
   worker without any shared memory;
+* every post-dedupe flight **incident** (the full JSON dump, not just
+  the trigger reason) is forwarded as an ``INCIDENT`` frame
+  (``WAFFLE_PROC_INCIDENTS``, default on) — the door re-ingests it
+  into its own recorder with worker attribution and fleet-level
+  dedupe;
+* a SUBMIT carrying a ``trace`` context is **adopted**: the local
+  job's spans record under the door's trace id / Chrome pid, and the
+  buffered span events travel back on ``RESULT``/``ERROR``/
+  ``CHECKPOINT`` frames (capped by ``WAFFLE_TRACE_SPAN_CAP``) so the
+  door can stitch one connected cross-process trace per job;
+* while metrics are enabled, a periodic ``STATS`` frame
+  (``WAFFLE_PROC_STATS_S``) ships this worker's registry snapshot and
+  rolling SLO windows for door-side federation;
 * ``PING`` answers ``PONG {outstanding, slots}``; ``DRAIN`` rejects
   further submits and asks every running search to checkpoint at its
   next pop boundary while inflight jobs finish; ``SHUTDOWN`` (or
@@ -42,9 +55,12 @@ import json
 import os
 import socket
 import sys
+import threading
+import time
 from typing import Any, Dict, Optional
 
 from waffle_con_tpu.serve.procs import wire
+from waffle_con_tpu.utils import envspec
 
 RECV_CHUNK = 1 << 16
 
@@ -73,7 +89,20 @@ class _Worker:
         self._send_lock = lockcheck.make_lock("procs.worker.send")
         self._make_thread = lockcheck.make_thread
         self._draining = False
+        self._stopped = threading.Event()
         self._slots = int(spec.get("workers", 2))
+        # the door arms observability in the spec when it was enabled
+        # programmatically on its side (bench --trace-out): env-var
+        # arming already travels via os.environ inheritance, but a
+        # forced enable_metrics()/Tracer.enable() does not
+        if spec.get("metrics"):
+            from waffle_con_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.enable_metrics(True)
+        if spec.get("trace"):
+            from waffle_con_tpu.obs import trace as obs_trace
+
+            obs_trace.get_tracer().enable(True)
         self._service = ConsensusService(
             ServeConfig(
                 workers=self._slots,
@@ -116,9 +145,82 @@ class _Worker:
             "detail": _json_safe(detail),
         })
 
+    def on_incident(self, incident: Dict) -> None:
+        """Forward one post-dedupe flight incident to the door
+        (``WAFFLE_PROC_INCIDENTS``; an oversized incident degrades to
+        its core identity fields, never to silence)."""
+        if envspec.get_raw("WAFFLE_PROC_INCIDENTS", "1") in ("", "0"):
+            return
+        try:
+            # round-trip through json with repr fallback: incident
+            # bodies may hold values the strict wire codec rejects
+            safe = json.loads(json.dumps(incident, default=repr))
+        except (TypeError, ValueError):
+            return
+        try:
+            self.send(wire.FrameType.INCIDENT,
+                      {"worker": self._name, "incident": safe})
+        except (wire.WireError, ValueError):
+            slim = {
+                k: safe.get(k)
+                for k in ("schema", "seq", "reason", "trace_id",
+                          "unix_time", "detail")
+            }
+            slim["truncated"] = True
+            try:
+                self.send(wire.FrameType.INCIDENT,
+                          {"worker": self._name, "incident": slim})
+            except (wire.WireError, ValueError):
+                pass
+
+    # -- federated metrics ---------------------------------------------
+
+    def _stats_loop(self) -> None:
+        """Ship this worker's registry snapshot + SLO windows to the
+        door every ``WAFFLE_PROC_STATS_S`` (first frame immediately, so
+        short-lived fleets still federate at least once)."""
+        from waffle_con_tpu.obs import flight as obs_flight
+        from waffle_con_tpu.obs import metrics as obs_metrics
+        from waffle_con_tpu.obs import slo as obs_slo
+
+        period = max(0.05, envspec.get_float("WAFFLE_PROC_STATS_S", 2.0))
+        while True:
+            try:
+                self.send(wire.FrameType.STATS, {
+                    "worker": self._name,
+                    "unix_time": time.time(),
+                    "metrics": obs_metrics.registry().snapshot(),
+                    "slo": obs_slo.snapshot(),
+                    "incidents": len(obs_flight.incidents()),
+                })
+            except Exception:  # noqa: BLE001 - one bad snapshot must
+                pass           # never kill the cadence
+            if self._stopped.wait(period):
+                return
+
+    # -- span-buffer return --------------------------------------------
+
+    def _span_payload(self, ctx) -> Optional[Dict]:
+        """Drain this job's buffered span events (by adopted Chrome
+        pid) for shipment; ``None`` when tracing is off or there is
+        nothing to ship — the frame field is absent, not empty."""
+        if ctx is None:
+            return None
+        from waffle_con_tpu.obs import trace as obs_trace
+
+        tracer = obs_trace.get_tracer()
+        if not tracer.enabled:
+            return None
+        cap = envspec.get_int("WAFFLE_TRACE_SPAN_CAP", 512, lo=16)
+        events = tracer.drain_events(ctx.chrome_pid, limit=cap)
+        if not events:
+            return None
+        return {"events": events, "origin_us": tracer.unix_origin_us()}
+
     # -- frame handlers ------------------------------------------------
 
-    def _watch(self, job_id: int, handle) -> None:
+    def _watch(self, job_id: int, handle, ctx=None,
+               flow_id: Optional[int] = None) -> None:
         """Report one job's lifecycle back to the door, in order."""
         from waffle_con_tpu.serve.job import JobStatus
 
@@ -127,15 +229,26 @@ class _Worker:
             self.send(wire.FrameType.STARTED, {"job": job_id})
         handle.wait()
         status = handle.status
+        if ctx is not None and flow_id is not None:
+            # return-hop flow arrow: started here, finished by the door
+            # at RESULT/ERROR ingest; the event ships in the span drain
+            from waffle_con_tpu.obs import trace as obs_trace
+
+            obs_trace.get_tracer().flow("s", flow_id + 1, "result",
+                                        ctx=ctx)
+        spans = self._span_payload(ctx)
         if status is JobStatus.DONE:
             try:
-                self.send(wire.FrameType.RESULT, {
+                frame = {
                     "job": job_id,
                     "kind": handle.request.kind,
                     "result": wire.encode_result(
                         handle.request.kind, handle.result(timeout=0)
                     ),
-                })
+                }
+                if spans is not None:
+                    frame["spans"] = spans
+                self.send(wire.FrameType.RESULT, frame)
             except Exception as exc:  # noqa: BLE001 - an unencodable
                 # result (oversized frame, NaN score, …) must still
                 # settle the door-side handle, so report it as a
@@ -166,7 +279,22 @@ class _Worker:
             # the search's final checkpoint so the client can resubmit
             # with a fresh budget instead of restarting from scratch
             frame["checkpoint"] = handle.checkpoint
+        if spans is not None:
+            frame["spans"] = spans
         self.send(wire.FrameType.ERROR, frame)
+
+    def _send_checkpoint(self, job_id: int, data, ctx) -> None:
+        frame = {
+            "job": job_id,
+            "data": data,
+            "bytes": len(json.dumps(data, separators=(",", ":"))),
+        }
+        # long jobs stream completed spans incrementally with their
+        # snapshots; the final RESULT/ERROR drains the remainder
+        spans = self._span_payload(ctx)
+        if spans is not None:
+            frame["spans"] = spans
+        self.send(wire.FrameType.CHECKPOINT, frame)
 
     def _on_submit(self, obj: Dict) -> None:
         job_id = int(obj["job"])
@@ -178,9 +306,21 @@ class _Worker:
             })
             return
         try:
+            trace_obj = wire.decode_trace(obj.get("trace"))
+        except wire.WireError:
+            trace_obj = None  # malformed context never fails a job
+        ctx = None
+        try:
             request = wire.decode_request(obj["request"])
+            if trace_obj is not None:
+                from waffle_con_tpu.obs import trace as obs_trace
+
+                # adopt the door's trace identity BEFORE the handle is
+                # queued: local spans then carry the door's trace id and
+                # Chrome pid, nesting under its per-job root span
+                ctx = obs_trace.context_from_wire(trace_obj)
             handle = self._service.submit(
-                request, checkpoint=obj.get("checkpoint")
+                request, checkpoint=obj.get("checkpoint"), trace=ctx
             )
         except Exception as exc:  # noqa: BLE001 — reported, not handled
             self.send(wire.FrameType.ERROR, {
@@ -188,15 +328,18 @@ class _Worker:
                 "type": type(exc).__name__, "message": str(exc),
             })
             return
-        handle.on_checkpoint = lambda data: self.send(
-            wire.FrameType.CHECKPOINT, {
-                "job": job_id,
-                "data": data,
-                "bytes": len(json.dumps(data, separators=(",", ":"))),
-            },
+        flow_id = trace_obj.get("flow_id") if trace_obj else None
+        if ctx is not None and flow_id is not None:
+            from waffle_con_tpu.obs import trace as obs_trace
+
+            # finish the door's submit-hop flow arrow on this side of
+            # the socket; the event travels back in the span drain
+            obs_trace.get_tracer().flow("f", flow_id, "submit", ctx=ctx)
+        handle.on_checkpoint = lambda data: self._send_checkpoint(
+            job_id, data, ctx
         )
         watcher = self._make_thread(
-            target=self._watch, args=(job_id, handle),
+            target=self._watch, args=(job_id, handle, ctx, flow_id),
             name=f"procs.worker.watch-{job_id}", daemon=True,
         )
         watcher.start()
@@ -212,11 +355,21 @@ class _Worker:
 
     def serve(self) -> None:
         from waffle_con_tpu.obs import flight as obs_flight
+        from waffle_con_tpu.obs import metrics as obs_metrics
 
         self.send(wire.FrameType.HELLO, {
             "worker": self._name, "pid": os.getpid(), "slots": self._slots,
         })
         obs_flight.add_trigger_listener(self.on_trigger)
+        obs_flight.add_incident_listener(self.on_incident)
+        if obs_metrics.metrics_enabled():
+            # federated metrics cadence; with metrics off no thread
+            # starts and no STATS frame is ever sent (zero-overhead:
+            # absent, not empty)
+            self._make_thread(
+                target=self._stats_loop,
+                name="procs.worker.stats", daemon=True,
+            ).start()
         try:
             while True:
                 try:
@@ -241,7 +394,9 @@ class _Worker:
                         return
                     # anything else from the door is ignored, not fatal
         finally:
+            self._stopped.set()
             obs_flight.remove_trigger_listener(self.on_trigger)
+            obs_flight.remove_incident_listener(self.on_incident)
             self._service.close(cancel_pending=True, timeout=10.0)
 
 

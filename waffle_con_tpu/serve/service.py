@@ -227,7 +227,7 @@ class ConsensusService:
     # -- client API ----------------------------------------------------
 
     def submit(self, request: JobRequest,
-               checkpoint=None) -> JobHandle:
+               checkpoint=None, trace=None) -> JobHandle:
         """Admit one job; raises :class:`ServiceOverloaded` when the
         bounded queue is full and :class:`ServiceClosed` after close.
 
@@ -237,6 +237,13 @@ class ConsensusService:
         of restarting from scratch.  A corrupt, version-skewed, or
         mismatched checkpoint never fails the job — it degrades to a
         fresh search with a ``checkpoint_rejected`` flight incident.
+
+        ``trace`` optionally replaces the handle's auto-minted
+        :class:`~waffle_con_tpu.obs.trace.TraceContext` — the proc
+        worker adopts the door's context here so its spans join the
+        door's per-job trace tree.  It must be installed before the
+        queue put: a pool worker may pick the handle up (and capture
+        ``handle.trace``) the moment it is queued.
         """
         if not isinstance(request, JobRequest):
             raise TypeError(
@@ -249,6 +256,8 @@ class ConsensusService:
             handle = JobHandle(
                 self._next_id, request, service=self.config.name
             )
+            if trace is not None:
+                handle.trace = trace
             self._next_id += 1
         if checkpoint is not None:
             handle._attach_checkpoint(checkpoint)

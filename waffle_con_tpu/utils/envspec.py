@@ -178,6 +178,18 @@ KNOBS: Dict[str, EnvKnob] = dict((
        "Front door: resume a lost worker's started jobs from their "
        "last checkpoint on another worker; 0 falls back to "
        "restart-from-scratch (restart_lost)"),
+    _k("WAFFLE_PROC_STATS_S", "float", "2.0",
+       "Worker: period in seconds between federated-metrics STATS "
+       "frames (each ships the worker's registry snapshot to the "
+       "door); only sent while metrics are enabled"),
+    _k("WAFFLE_TRACE_SPAN_CAP", "int", "512",
+       "Worker: max span events shipped back per RESULT/ERROR/"
+       "CHECKPOINT frame (latest kept -- completion order puts "
+       "enclosing spans at the tail); min 16"),
+    _k("WAFFLE_PROC_INCIDENTS", "flag", "1 (on)",
+       "Worker: forward every post-dedupe flight incident to the door "
+       "as an INCIDENT frame (door re-ingests with worker attribution "
+       "and fleet-level dedupe); `0` keeps incidents worker-local"),
 ))
 
 
