@@ -320,10 +320,14 @@ def _emit(out, perfdb_kind=None):
             rec["run_cols"] = breakdown["run_cols"]
         # tie-heavy records carry their headline companions so the
         # trend table tells the whole story from one line; crash-drill
-        # records carry their migration accounting the same way
-        for k in ("wall_s", "steps_per_s", "gang_occupancy",
+        # records carry their migration accounting, storm records their
+        # per-iteration walls, and cache records their hit accounting
+        # the same way
+        for k in ("wall_s", "wall_median_s", "iter_walls_s",
+                  "steps_per_s", "gang_occupancy",
                   "gang_commit_rate", "migrated", "restarted_started",
-                  "wasted_work_s", "migration_jobs"):
+                  "wasted_work_s", "migration_jobs", "hit_rate",
+                  "cache_hits", "checkpoint_jobs"):
             v = out.get(k)
             if v is None and isinstance(breakdown, dict):
                 v = breakdown.get(k)
@@ -1228,7 +1232,8 @@ def _storm_mix(num_jobs, error_rate, supervised):
     return shapes, priorities, jobs, offsets, arrival_span, large_threshold
 
 
-def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
+def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False,
+                iters=2):
     """Scale-out storm harness (``--storm N``): a heavy-tailed, bursty
     job mix fired at the replicated front door.
 
@@ -1242,8 +1247,9 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
     Two timed phases run the SAME mix on the SAME arrival schedule —
     one replica, then ``replicas`` replicas — each preceded by an
     untimed warmup pass that absorbs XLA compiles, and each timed
-    twice with the faster wall kept (noise-robust on shared CI
-    hosts; fault-armed phases time once).  Reports jobs/s for
+    ``iters`` times (default 2) with the faster wall kept and every
+    per-iteration wall recorded in the evidence (noise-robust on
+    shared CI hosts; fault-armed phases time once).  Reports jobs/s for
     both, the multi/single speedup, p50/p95/p99 job latency, a
     per-replica occupancy/routing table, and a parity bit over every
     completed job (both phases) against serial references.
@@ -1303,8 +1309,8 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
         counts must land in a single measured storm.  Every pass's
         results are parity-checked, not just the kept one."""
         ops_ragged.reset_arena()
-        timed_passes = 1 if arm is not None else 2
-        best, parity_ok = None, True
+        timed_passes = 1 if arm is not None else max(1, iters)
+        best, walls, parity_ok = None, [], True
         for _attempt in range(1 + timed_passes):
             if _attempt == 1 and arm is not None:
                 arm()
@@ -1335,17 +1341,18 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
             )
             if _attempt == 0:
                 continue
+            walls.append(wall)
             if best is None or wall < best[0]:
                 best = (wall, stats, rep_stats, lats)
-        return best + (parity_ok,)
+        return best + (walls, parity_ok)
 
-    s_wall, _s_stats, _s_reps, _s_lat, s_parity = run_phase(1)
+    s_wall, _s_stats, _s_reps, _s_lat, s_walls, s_parity = run_phase(1)
     arm = None
     if fault_spec:
         arm = lambda: runtime_faults.install(  # noqa: E731
             runtime_faults.plan_from_env(fault_spec)
         )
-    m_wall, m_stats, m_reps, m_lat, m_parity = run_phase(
+    m_wall, m_stats, m_reps, m_lat, m_walls, m_parity = run_phase(
         replicas, arm=arm
     )
     if fault_spec:
@@ -1375,6 +1382,9 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
         "jobs_per_s_single": round(num_jobs / s_wall, 4),
         "speedup_vs_single": round(s_wall / m_wall, 4),
         "wall_s": round(m_wall, 4),
+        "wall_median_s": round(_time_stats(m_walls)[1], 4),
+        "iter_walls_s": [round(w, 4) for w in m_walls],
+        "iter_walls_single_s": [round(w, 4) for w in s_walls],
         "arrival_span_s": round(arrival_span, 4),
         "p50_job_latency_s": round(p50, 4),
         "p95_job_latency_s": round(p95, 4),
@@ -1411,7 +1421,7 @@ def bench_storm(num_jobs, replicas=2, error_rate=0.01, supervised=False):
 
 def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
                       kill_worker=False, trace_out=None,
-                      supervised=False):
+                      supervised=False, iters=2):
     """Out-of-process storm (``--storm N --procs P``): the exact
     workload and arrival schedule of :func:`bench_storm`, fired at the
     :class:`~waffle_con_tpu.serve.procs.door.ProcFrontDoor` with real
@@ -1421,9 +1431,10 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
     ``procs`` workers.  A phase spawns its door ONCE and reuses it for
     the untimed warmup pass (absorbs each worker's XLA compiles — the
     fleet shares the persistent compile cache, so later workers mostly
-    load what the first compiled) plus two timed passes, keeping the
-    faster wall.  Every pass's results are parity-checked byte-for-byte
-    against in-process serial references.
+    load what the first compiled) plus ``iters`` timed passes (default
+    2), keeping the faster wall and recording every per-iteration wall
+    in the evidence line.  Every pass's results are parity-checked
+    byte-for-byte against in-process serial references.
 
     ``kill_worker=True`` is the crash drill: during the (single) timed
     multi-worker pass the busiest worker is SIGKILLed after a third of
@@ -1533,8 +1544,8 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
             placement=policy,
             name="storm",
         ))
-        timed_passes = 1 if kill else 2
-        best, parity_ok, killed = None, True, None
+        timed_passes = 1 if kill else max(1, iters)
+        best, walls, parity_ok, killed = None, [], True, None
         kill_mono, kill_handles, warm_lats = None, None, None
         try:
             for _attempt in range(1 + timed_passes):
@@ -1617,16 +1628,18 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
                     continue
                 if kill:
                     kill_handles = list(handles)
+                walls.append(wall)
                 if best is None or wall < best[0]:
                     best = (wall, lats)
             stats = door.stats()
             workers = door.worker_stats()
         finally:
             door.close()
-        return best + (stats, workers, parity_ok, killed, kill_mono,
-                       kill_handles, warm_lats)
+        return best + (stats, workers, walls, parity_ok, killed,
+                       kill_mono, kill_handles, warm_lats)
 
-    s_wall, _s_lat, _s_stats, _s_workers, s_parity = run_phase(1)[:5]
+    (s_wall, _s_lat, _s_stats, _s_workers, s_walls,
+     s_parity) = run_phase(1)[:6]
     if fault_spec:
         # restore the env plan for the multi-worker phase only: its
         # workers spawn after this and resolve WAFFLE_FAULTS lazily
@@ -1635,7 +1648,7 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
     if tracer is not None:
         # the written trace covers exactly the multi-worker phase
         tracer.clear()
-    (m_wall, m_lat, m_stats, m_workers, m_parity, killed,
+    (m_wall, m_lat, m_stats, m_workers, m_walls, m_parity, killed,
      kill_mono, kill_handles, warm_lats) = run_phase(procs,
                                                      kill=kill_worker)
     trace_spans = 0
@@ -1669,6 +1682,9 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
         "jobs_per_s_single": round(num_jobs / s_wall, 4),
         "speedup_vs_single": round(s_wall / m_wall, 4),
         "wall_s": round(m_wall, 4),
+        "wall_median_s": round(_time_stats(m_walls)[1], 4),
+        "iter_walls_s": [round(w, 4) for w in m_walls],
+        "iter_walls_single_s": [round(w, 4) for w in s_walls],
         "arrival_span_s": round(arrival_span, 4),
         "p50_job_latency_s": round(p50, 4),
         "p95_job_latency_s": round(p95, 4),
@@ -1751,6 +1767,244 @@ def bench_storm_procs(num_jobs, procs=2, error_rate=0.01,
                     "serial_wall_s": round(serial_walls[idx], 4),
                 })
         out["migration_jobs"] = migration_jobs
+    return out
+
+
+def bench_storm_cache(num_jobs, error_rate=0.03, iters=2):
+    """Duplicate-heavy + superset-heavy cache storm (``--storm N
+    --cache``): measures the content-addressed consensus cache at
+    :class:`~waffle_con_tpu.serve.service.ConsensusService` admission.
+
+    The mix derives from ``max(2, num_jobs // 4)`` unique single-kind
+    jobs; each unique spawns three cache-traffic variants:
+
+    * an **exact duplicate** with the reads permuted — must be served
+      from the exact-hit tier (``CACHED``, ``started_at is None``:
+      zero worker dispatches) with per-read scores remapped to the
+      submitted order;
+    * a **certify superset** (the unique's reads plus a copy of its
+      consensus sequence) — the cached result becomes a proposal that
+      one exact DWFA scoring pass proves optimal (``CERTIFIED``);
+    * a **checkpoint superset** (the unique's reads plus one extra
+      noisy read) — certification fails (the extra read raises the
+      optimal cost), so the search resumes from the unique's deposited
+      last bound-free checkpoint instead of starting from scratch
+      (``DONE``, byte-identical by the no-incumbent-pruning argument
+      in ``serve/cache``).
+
+    Each of the ``iters`` timed iterations builds a FRESH service
+    (fresh cache): a seed phase submits the uniques and waits for them
+    (deposits land), then the timed phase fires every variant.  Every
+    cache-served result is parity-checked byte-for-byte against a
+    from-scratch serial reference computed on the variant's exact read
+    order, exact-hit counts are checked deterministic (one per
+    duplicate, all dispatch-free), and the evidence line carries the
+    per-checkpoint-job resumed-vs-scratch walls (the overlap-reuse
+    win) plus the aggregate ``hit_rate`` the perfdb ``storm-cache``
+    trend gate rides on."""
+    import numpy as np
+
+    from waffle_con_tpu import CdwfaConfigBuilder
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.obs import metrics as obs_metrics
+    from waffle_con_tpu.obs import slo as obs_slo
+    from waffle_con_tpu.ops import ragged as ops_ragged
+    from waffle_con_tpu.serve import (
+        ConsensusService,
+        JobRequest,
+        JobStatus,
+        ServeConfig,
+    )
+    from waffle_con_tpu.utils.example_gen import generate_test
+
+    rng = np.random.default_rng(20260807)
+    n_unique = max(2, num_jobs // 4)
+
+    uniques = []  # (reads, cfg, seq_len)
+    for i in range(n_unique):
+        n_reads = int(rng.integers(6, 11))
+        seq_len = int(rng.integers(140, 200))
+        reads = tuple(
+            generate_test(4, seq_len, n_reads, error_rate,
+                          seed=3000 + i)[1]
+        )
+        cfg = (
+            CdwfaConfigBuilder()
+            .min_count(max(2, n_reads // 4))
+            .backend("jax")
+            .initial_band(_band_seed(seq_len, error_rate))
+            .build()
+        )
+        uniques.append((reads, cfg, seq_len))
+
+    def _serial(reads, cfg, passes=1):
+        """From-scratch reference + wall; ``passes=2`` keeps the faster
+        wall (the honest scratch baseline resumed walls are judged
+        against — pass one may still absorb an XLA compile)."""
+        ref, wall = None, None
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            ref = _make_engine("single", cfg, reads).consensus()
+            w = time.perf_counter() - t0
+            wall = w if wall is None else min(wall, w)
+        return ref, wall
+
+    seed_refs = [_serial(reads, cfg)[0] for reads, cfg, _ in uniques]
+
+    # the three cache-traffic variants per unique, each with its own
+    # serial reference on the EXACT submitted read order (per-read
+    # scores follow read order, so a permuted duplicate has a permuted
+    # reference); only the checkpoint-superset variant's scratch wall
+    # is evidence, so only it pays a second timing pass
+    variants = []  # (tag, unique_idx, reads, cfg, ref, scratch_wall)
+    for i, (reads, cfg, seq_len) in enumerate(uniques):
+        perm = [int(p) for p in rng.permutation(len(reads))]
+        dup_reads = tuple(reads[j] for j in perm)
+        extra = generate_test(4, seq_len, 1, 0.05, seed=9000 + i)[1][0]
+        for tag, v_reads, passes in (
+            ("dup", dup_reads, 1),
+            ("cert", reads + (seed_refs[i][0].sequence,), 1),
+            ("ckpt", reads + (extra,), 2),
+        ):
+            ref, scratch = _serial(v_reads, cfg, passes)
+            variants.append((tag, i, v_reads, cfg, ref, scratch))
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("WAFFLE_CACHE", "WAFFLE_CKPT_INTERVAL_S")
+    }
+    os.environ["WAFFLE_CACHE"] = "1"
+    # dense snapshots during the seed phase so every unique deposits a
+    # final checkpoint for the superset tier to resume from
+    os.environ["WAFFLE_CKPT_INTERVAL_S"] = "0.001"
+
+    best = None
+    walls, parity_ok = [], True
+    exact_ok, ckpt_hits_total = True, 0
+    try:
+        for _iter in range(max(1, iters)):
+            ops_ragged.reset_arena()
+            svc = ConsensusService(ServeConfig(
+                workers=min(n_unique, 4),
+                queue_limit=max(8, 4 * num_jobs),
+                batch_window_s=0.005,
+                max_batch=8,
+                name="storm-cache",
+            ))
+            try:
+                # seed phase (untimed): deposits land before the storm
+                seed_handles = [
+                    svc.submit(JobRequest(kind="single", reads=reads,
+                                          config=cfg))
+                    for reads, cfg, _ in uniques
+                ]
+                seed_results = [h.result() for h in seed_handles]
+                parity_ok = parity_ok and all(
+                    r == ref for r, ref in zip(seed_results, seed_refs)
+                )
+                # deposits land asynchronously after result(): wait for
+                # them so the timed phase sees a fully seeded cache
+                t_dep = time.perf_counter()
+                while (svc.stats().get("cache", {}).get("deposits", 0)
+                       < n_unique
+                       and time.perf_counter() - t_dep < 10.0):
+                    time.sleep(0.005)
+                time.sleep(0.05)  # checkpoint deposit follows result's
+
+                t0 = time.perf_counter()
+                handles = [
+                    svc.submit(JobRequest(kind="single", reads=v_reads,
+                                          config=cfg))
+                    for _tag, _i, v_reads, cfg, _ref, _w in variants
+                ]
+                results = [h.result() for h in handles]
+                wall = time.perf_counter() - t0
+
+                parity_ok = parity_ok and all(
+                    r == ref
+                    for r, (_t, _i, _r, _c, ref, _w)
+                    in zip(results, variants)
+                )
+                # exact duplicates must never touch a worker
+                exact_ok = exact_ok and all(
+                    h.status is JobStatus.CACHED
+                    and h.started_at is None
+                    for h, (tag, *_rest) in zip(handles, variants)
+                    if tag == "dup"
+                )
+                cstats = svc.stats()["cache"]
+                ckpt_hits_total += cstats.get("checkpoint", 0)
+                ckpt_jobs = [
+                    {
+                        "unique": i,
+                        "resumed_wall_s": round(h.latency_s, 4),
+                        "scratch_wall_s": round(scratch, 4),
+                    }
+                    for h, (tag, i, _r, _c, _ref, scratch)
+                    in zip(handles, variants)
+                    if tag == "ckpt" and h.status is JobStatus.DONE
+                ]
+                statuses = [h.status.value for h in handles]
+                lats = sorted(h.latency_s for h in handles)
+            finally:
+                svc.close()
+            walls.append(wall)
+            if best is None or wall < best[0]:
+                best = (wall, cstats, ckpt_jobs, statuses, lats)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    wall, cstats, ckpt_jobs, statuses, lats = best
+    n_variants = len(variants)
+    hits = (cstats.get("exact", 0) + cstats.get("certified", 0)
+            + cstats.get("checkpoint", 0))
+    p50 = lats[len(lats) // 2]
+    p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+    resumed_total = sum(j["resumed_wall_s"] for j in ckpt_jobs)
+    scratch_total = sum(j["scratch_wall_s"] for j in ckpt_jobs)
+    out = {
+        "metric": f"storm_cache_{num_jobs}jobs_jobs_per_s",
+        "value": round(n_variants / wall, 4),
+        "unit": "jobs/s",
+        "mode": "storm-cache",
+        "jobs": n_variants,
+        "uniques": n_unique,
+        "jobs_per_s": round(n_variants / wall, 4),
+        "wall_s": round(wall, 4),
+        "wall_median_s": round(_time_stats(walls)[1], 4),
+        "iter_walls_s": [round(w, 4) for w in walls],
+        "p50_job_latency_s": round(p50, 4),
+        "p95_job_latency_s": round(p95, 4),
+        "parity": parity_ok,
+        # the tentpole evidence: hit-rate over the cache-traffic storm,
+        # dispatch-free exact hits, and resumed-vs-scratch walls for
+        # the checkpoint-superset tier
+        "hit_rate": round(hits / n_variants, 4),
+        "cache_hits": hits,
+        "cache": cstats,
+        "exact_hits_dispatch_free": exact_ok,
+        "exact_hits": cstats.get("exact", 0),
+        "certified_hits": cstats.get("certified", 0),
+        "checkpoint_hits": cstats.get("checkpoint", 0),
+        "checkpoint_hits_all_iters": ckpt_hits_total,
+        "checkpoint_jobs": ckpt_jobs,
+        "resumed_wall_total_s": round(resumed_total, 4),
+        "scratch_wall_total_s": round(scratch_total, 4),
+        "statuses": statuses,
+        "slo": obs_slo.snapshot(),
+        "incidents": [
+            {k: inc.get(k) for k in
+             ("seq", "reason", "trace_id", "unix_time", "path")}
+            for inc in obs_flight.incidents()
+        ],
+        "runtime_events": _runtime_events(),
+    }
+    if obs_metrics.metrics_enabled():
+        out["metrics"] = obs_metrics.registry().snapshot()
     return out
 
 
@@ -2202,6 +2456,14 @@ def main() -> None:
         "worker_lost incident; never appends a perfdb record",
     )
     parser.add_argument(
+        "--cache", action="store_true", dest="storm_cache",
+        help="with --storm: duplicate-heavy + superset-heavy cache "
+        "storm through the content-addressed consensus cache; reports "
+        "hit rate per tier (exact/certified/checkpoint), dispatch-free "
+        "exact hits, resumed-vs-scratch walls for checkpoint-superset "
+        "jobs, and a parity bit over every cache-served result",
+    )
+    parser.add_argument(
         "--serve-supervised", action="store_true",
         help="with --serve: run the served jobs under the fault-"
         "tolerant supervisor (warmup stays unsupervised), so "
@@ -2360,6 +2622,21 @@ def main() -> None:
         from waffle_con_tpu.utils.cache import enable_compilation_cache
 
         enable_compilation_cache()
+        storm_iters = args.iters if args.iters != 5 else 2
+        if args.storm_cache:
+            out = bench_storm_cache(args.storm, iters=storm_iters)
+            out["device_platform"] = _current_platform()
+            _emit(out, perfdb_kind="storm-cache")
+            if not (out["parity"] and out["exact_hits_dispatch_free"]
+                    and out["hit_rate"] > 0):
+                print(
+                    f"FAIL: cache storm parity={out['parity']} "
+                    f"dispatch_free={out['exact_hits_dispatch_free']} "
+                    f"hit_rate={out['hit_rate']}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+            return
         if args.procs:
             out = bench_storm_procs(
                 args.storm,
@@ -2367,6 +2644,7 @@ def main() -> None:
                 kill_worker=args.kill_worker,
                 trace_out=args.trace_out,
                 supervised=args.serve_supervised,
+                iters=storm_iters,
             )
             out["device_platform"] = _current_platform()
             # crash drills measure degraded-mode behaviour: they land
@@ -2381,6 +2659,7 @@ def main() -> None:
             args.storm,
             replicas=args.replicas,
             supervised=args.serve_supervised,
+            iters=storm_iters,
         )
         out["device_platform"] = _current_platform()
         # fault-injected (shedding-demo) runs measure degraded-mode

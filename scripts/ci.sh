@@ -585,6 +585,58 @@ print(
 )
 PY
 
+echo "== storm-cache smoke (duplicate-heavy consensus cache) =="
+CACHE_OUT="$(mktemp /tmp/waffle_ci_cache.XXXXXX.json)"
+trap 'rm -rf "$SMOKE_OUT" "$TRACE_OUT" "$SERVE_OUT" "$FLIGHT_DIR" "$FLIGHT_OUT" "$MIX_OUT" "$STORM_OUT" "$SHED_OUT" "$PROCS_OUT" "$KILL_OUT" "$FLEET_OUT" "$FLEET_TRACE" "$FLEET_FLIGHT" "$CACHE_OUT"' EXIT
+
+# duplicate-heavy + superset-heavy traffic through the content-addressed
+# cache: exact duplicates (permuted read order) must be served CACHED
+# without ever reaching a worker, cached-consensus supersets certify by
+# one oracle pass, and checkpoint supersets resume from a deposited
+# bound-free frontier.  bench exits 1 itself unless parity holds, every
+# exact hit is dispatch-free, and hit_rate > 0; the assertions below
+# re-check those fields from the evidence JSON and pin the tier split.
+WAFFLE_METRICS=1 WAFFLE_LOCKCHECK=1 \
+  python bench.py --storm 8 --cache --platform cpu > "$CACHE_OUT"
+
+python - "$CACHE_OUT" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1]) as fh:
+    evidence = json.loads(fh.read().strip().splitlines()[-1])
+assert evidence.get("mode") == "storm-cache", sorted(evidence)
+assert evidence["parity"] is True, (
+    "cache-served result diverged from serial reference"
+)
+assert evidence["hit_rate"] > 0, evidence["hit_rate"]
+assert evidence["exact_hits_dispatch_free"] is True, (
+    "an exact duplicate was dispatched to a worker"
+)
+cache = evidence["cache"]
+assert cache["exact"] >= 1, cache
+assert cache["deposits"] >= 1, cache
+assert evidence["checkpoint_hits_all_iters"] >= 1, (
+    f"no superset job resumed from a cached checkpoint: {cache}"
+)
+ckpt_jobs = evidence["checkpoint_jobs"]
+assert ckpt_jobs and all(
+    j["resumed_wall_s"] < j["scratch_wall_s"] for j in ckpt_jobs
+), f"a resumed superset job did not beat its from-scratch wall: {ckpt_jobs}"
+hits = [
+    k for k in evidence.get("metrics", {})
+    if k.startswith("waffle_cache")
+]
+assert "waffle_cache_hits_total" in hits, hits
+print(
+    f"ci storm-cache smoke ok: hit_rate={evidence['hit_rate']}, "
+    f"tiers exact={cache['exact']} certified={cache['certified']} "
+    f"checkpoint={cache['checkpoint']}, "
+    f"resumed {evidence['resumed_wall_total_s']}s vs "
+    f"scratch {evidence['scratch_wall_total_s']}s, parity held"
+)
+PY
+
 echo "== perfdb serving trend gate (serve-mix + storm jobs/s) =="
 # the serving smokes above appended their records; gate each kind's
 # latest against its own same-platform, same-metric rolling baseline.
@@ -603,6 +655,18 @@ python scripts/perf_report.py --check \
 python scripts/perf_report.py --check \
   --kinds serve-mix,serve-mix-mixed-w,storm,storm-procs,tie_heavy \
   --tolerance "${WAFFLE_PERFDB_SERVE_TOLERANCE:-0.15}" \
+  --window "${WAFFLE_PERFDB_WINDOW:-10}" \
+  --floor "$MICRO_FLOOR"
+# storm-cache gets its own wider band (WAFFLE_PERFDB_CACHE_TOLERANCE,
+# default 30%): its timed wall is dominated by the checkpoint-tier
+# resume searches (whole seconds each), which jitter ~20% run-to-run
+# on the shared 1-core host.  A real cache regression — exact hits
+# dispatching, the checkpoint tier dead — costs far more than 30%,
+# and the hit-rate/parity/dispatch-free gates above catch structural
+# breaks independent of wall time.
+python scripts/perf_report.py --check \
+  --kinds storm-cache \
+  --tolerance "${WAFFLE_PERFDB_CACHE_TOLERANCE:-0.30}" \
   --window "${WAFFLE_PERFDB_WINDOW:-10}" \
   --floor "$MICRO_FLOOR"
 python scripts/perf_report.py

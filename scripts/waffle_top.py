@@ -103,6 +103,20 @@ def render(payload: dict, plain: bool = False) -> str:
             f"mean_occupancy={dispatch.get('mean_batch_occupancy', 0):.2f} "
             f"max_occupancy={dispatch.get('occupancy_max', 0)}"
         )
+    cache = stats.get("cache")
+    if cache:
+        hits = (cache.get("exact", 0) + cache.get("certified", 0)
+                + cache.get("checkpoint", 0))
+        lines.append(
+            f"cache: hits={hits} "
+            f"(exact={cache.get('exact', 0)} "
+            f"certified={cache.get('certified', 0)} "
+            f"ckpt={cache.get('checkpoint', 0)}) "
+            f"misses={cache.get('misses', 0)} "
+            f"quarantined={cache.get('quarantined', 0)}  "
+            f"store={cache.get('results', 0)}r/"
+            f"{cache.get('checkpoints', 0)}c"
+        )
 
     replicas = payload.get("replicas") or stats.get("replicas")
     if replicas:

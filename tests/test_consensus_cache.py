@@ -1,0 +1,345 @@
+"""Content-addressed consensus cache (``serve/cache``).
+
+Canonical-hash properties (the satellite contract): read-order
+permutation invariance, duplicate-read multiplicity sensitivity,
+scoring-config field sensitivity, placement-only field insensitivity.
+Plus the store layer (LRU bounds, file-store hash-sealing and
+quarantine), the bound-free checkpoint deposit gate, and the service
+integration: exact hits serve ``CACHED`` without touching a worker,
+near-miss proposals certify to ``CERTIFIED`` at the optimal cost or
+degrade, checkpoint supersets resume — every served byte identical to
+the serial reference.
+"""
+
+import json
+import os
+
+import pytest
+
+from waffle_con_tpu import CdwfaConfigBuilder
+from waffle_con_tpu.serve import (
+    ConsensusService,
+    JobRequest,
+    JobStatus,
+    ServeConfig,
+)
+from waffle_con_tpu.serve.cache import (
+    ConsensusCache,
+    keys,
+    resumable_wire,
+)
+from waffle_con_tpu.serve.cache.store import FileStore, ResultStore
+from waffle_con_tpu.serve.service import _build_engine
+from waffle_con_tpu.utils.example_gen import generate_test
+
+pytestmark = pytest.mark.serve
+
+
+def _cfg(backend="python", **kw):
+    b = CdwfaConfigBuilder().backend(backend)
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _reads(n=6, seq_len=120, error=0.02, seed=11):
+    return tuple(generate_test(4, seq_len, n, error, seed=seed)[1])
+
+
+def _req(reads, config=None, kind="single", **kw):
+    return JobRequest(kind=kind, reads=reads, config=config, **kw)
+
+
+# ------------------------------------------------- canonical hash
+
+
+def test_key_invariant_under_read_permutation():
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    permuted = reads[::-1]
+    assert permuted != reads
+    assert keys.request_key(_req(reads, cfg)) == \
+        keys.request_key(_req(permuted, cfg))
+
+
+def test_key_sensitive_to_duplicate_multiplicity():
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    doubled = reads + (reads[0],)
+    assert keys.request_key(_req(reads, cfg)) != \
+        keys.request_key(_req(doubled, cfg))
+
+
+def test_key_sensitive_to_scoring_fields():
+    reads = _reads()
+    base = keys.request_key(_req(reads, _cfg(min_count=2)))
+    assert base != keys.request_key(_req(reads, _cfg(min_count=3)))
+    assert base != keys.request_key(
+        _req(reads, _cfg(min_count=2, wildcard=ord("*")))
+    )
+
+
+def test_key_insensitive_to_placement_fields():
+    reads = _reads()
+    base = keys.request_key(_req(reads, _cfg(min_count=2)))
+    jax_meshed = _cfg(
+        backend="jax", min_count=2, mesh_shards=2, initial_band=9,
+    )
+    assert keys.request_key(_req(reads, jax_meshed)) == base
+
+
+def test_key_sensitive_to_kind_and_offsets():
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    base = keys.request_key(_req(reads, cfg))
+    assert base != keys.request_key(_req(reads, cfg, kind="dual"))
+    seeded = _req(reads, cfg, offsets=(None,) * (len(reads) - 1) + (3,))
+    assert base != keys.request_key(seeded)
+
+
+def test_priority_chains_keep_within_chain_order():
+    cfg = _cfg(min_count=2)
+    c1, c2 = (b"\x00\x01", b"\x02\x03"), (b"\x01\x02", b"\x03\x00")
+    key = keys.request_key(_req((c1, c2), cfg, kind="priority"))
+    # chain multiset is order-insensitive ...
+    assert key == keys.request_key(_req((c2, c1), cfg, kind="priority"))
+    # ... but within-chain order is positional seeding: never collapsed
+    flipped = (tuple(reversed(c1)), c2)
+    assert key != keys.request_key(_req(flipped, cfg, kind="priority"))
+
+
+def test_multiset_extras_and_match_permutation():
+    reads = _reads()
+    extra = b"\x00\x01\x02\x03"
+    extras = keys.multiset_extras(reads + (extra,), reads)
+    assert extras == (extra,)
+    assert keys.multiset_extras(reads[:-1], reads) is None
+    # duplicate copies count: one copy is not a superset of two
+    assert keys.multiset_extras(reads, reads + (reads[0],)) is None
+
+    stored = keys.read_elements(_req(reads, None))
+    wanted = keys.read_elements(_req(reads[::-1], None))
+    perm = keys.match_permutation(wanted, stored)
+    assert perm is not None
+    assert [stored[j] for j in perm] == wanted
+    assert keys.match_permutation(
+        keys.read_elements(_req(reads + (extra,), None)), stored
+    ) is None
+
+
+# ------------------------------------------------- stores
+
+
+def test_result_store_is_bounded_lru():
+    store = ResultStore(2)
+    store.put("a", 1)
+    store.put("b", 2)
+    assert store.get("a") == 1  # refreshes "a"
+    store.put("c", 3)  # evicts "b", the least recently used
+    assert store.get("b") is None
+    assert store.get("a") == 1 and store.get("c") == 3
+    assert len(store) == 2
+
+
+def test_file_store_round_trip_and_quarantine(tmp_path):
+    store = FileStore(str(tmp_path))
+    store.put("k1", {"kind": "single", "result": [1, 2]})
+    assert store.get("k1") == {"kind": "single", "result": [1, 2]}
+    # reopening reads the manifest back
+    assert FileStore(str(tmp_path)).get("k1") is not None
+
+    # corrupt the sealed bytes: the digest mismatch quarantines the
+    # entry — it is never served again, from this or a fresh store
+    victim = next(
+        p for p in tmp_path.iterdir()
+        if p.is_file() and p.name != "MANIFEST.json"
+    )
+    victim.write_bytes(victim.read_bytes() + b" ")
+    assert store.get("k1") is None
+    assert store.quarantined == 1
+    assert (tmp_path / "_quarantine").exists()
+    assert FileStore(str(tmp_path)).get("k1") is None
+
+
+# ------------------------------------------------- checkpoint gate
+
+
+def _wire_ckpt(entries=1, maximum_error=None, results=()):
+    return {
+        "version": 1, "kind": "single",
+        "body": {"state": {
+            "entries": [{"n": i} for i in range(entries)],
+            "maximum_error": maximum_error,
+            "results": list(results),
+        }},
+    }
+
+
+def test_resumable_wire_accepts_only_bound_free_frontiers():
+    assert resumable_wire(_wire_ckpt())
+    # an incumbent bound would prune the superset's optimum with
+    # subset-only costs: never resumable
+    assert not resumable_wire(_wire_ckpt(maximum_error=7))
+    assert not resumable_wire(_wire_ckpt(results=[{"c": 1}]))
+    assert not resumable_wire(_wire_ckpt(entries=0))
+    assert not resumable_wire({"body": {}})
+    assert not resumable_wire(None)
+
+
+def test_deposit_checkpoint_rejects_bounded_snapshots():
+    cache = ConsensusCache("t")
+    req = _req(_reads(), _cfg(min_count=2))
+    cache.deposit_checkpoint(req, _wire_ckpt(maximum_error=3))
+    assert cache.stats()["ckpt_deposits"] == 0
+    cache.deposit_checkpoint(req, _wire_ckpt())
+    assert cache.stats()["ckpt_deposits"] == 1
+
+
+# ------------------------------------------------- service integration
+
+
+def _serial(request):
+    return _build_engine(request).consensus()
+
+
+@pytest.fixture
+def cache_env(monkeypatch):
+    monkeypatch.setenv("WAFFLE_CACHE", "1")
+    return monkeypatch
+
+
+def test_exact_duplicate_served_cached_and_dispatch_free(cache_env):
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    dup = _req(reads[::-1], cfg)
+    want = _serial(dup)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        first = svc.submit(_req(reads, cfg))
+        first.result(timeout=300)
+        _wait_deposits(svc, 1)
+        second = svc.submit(dup)
+        got = second.result(timeout=300)
+        stats = svc.stats()
+    assert second.status is JobStatus.CACHED
+    assert second.started_at is None  # never dispatched
+    assert got == want  # scores remapped to the submitted read order
+    assert stats["cache"]["exact"] == 1
+    assert stats["jobs"]["cached"] == 1
+
+
+def test_superset_with_cached_consensus_certifies(cache_env):
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        first = svc.submit(_req(reads, cfg))
+        base = first.result(timeout=300)
+        _wait_deposits(svc, 1)
+        superset = _req(reads + (base[0].sequence,), cfg)
+        want = _serial(superset)
+        handle = svc.submit(superset)
+        got = handle.result(timeout=300)
+        stats = svc.stats()
+    assert handle.status is JobStatus.CERTIFIED
+    assert got == want
+    assert stats["cache"]["certified"] == 1
+
+
+def test_certify_failure_degrades_to_full_search(cache_env):
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    noisy = generate_test(4, 120, 1, 0.3, seed=99)[1][0]
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        svc.submit(_req(reads, cfg)).result(timeout=300)
+        _wait_deposits(svc, 1)
+        superset = _req(reads + (noisy,), cfg)
+        want = _serial(superset)
+        handle = svc.submit(superset)
+        got = handle.result(timeout=300)
+        stats = svc.stats()
+    # the noisy extra raises the optimal cost past the cached bound:
+    # the proposal fails certification and the job runs a real search
+    assert handle.status is JobStatus.DONE
+    assert got == want
+    assert stats["cache"]["certify_failed"] >= 1
+
+
+def test_checkpoint_superset_resumes_with_parity(cache_env):
+    cache_env.setenv("WAFFLE_CKPT_INTERVAL_S", "0.0001")
+    cache_env.setenv("WAFFLE_CACHE_PROPOSALS", "0")  # isolate the tier
+    reads = _reads(n=8, seq_len=160, error=0.03, seed=21)
+    extra = generate_test(4, 160, 1, 0.05, seed=22)[1][0]
+    cfg = _cfg(min_count=2)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        svc.submit(_req(reads, cfg)).result(timeout=300)
+        _wait_deposits(svc, 1)
+        if svc.stats()["cache"]["ckpt_deposits"] == 0:
+            pytest.skip("search finished before a bound-free snapshot")
+        superset = _req(reads + (extra,), cfg)
+        want = _serial(superset)
+        handle = svc.submit(superset)
+        got = handle.result(timeout=300)
+        stats = svc.stats()
+    assert handle.status is JobStatus.DONE
+    assert got == want  # bound-free resume is byte-identical
+    assert stats["cache"]["checkpoint"] == 1
+    assert stats["checkpoints"]["resumed"] >= 1
+
+
+def test_resumed_jobs_never_deposit(cache_env):
+    cache_env.setenv("WAFFLE_CKPT_INTERVAL_S", "0.0001")
+    cache_env.setenv("WAFFLE_CACHE_PROPOSALS", "0")
+    reads = _reads(n=8, seq_len=160, error=0.03, seed=21)
+    extra = generate_test(4, 160, 1, 0.05, seed=22)[1][0]
+    cfg = _cfg(min_count=2)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        svc.submit(_req(reads, cfg)).result(timeout=300)
+        _wait_deposits(svc, 1)
+        if svc.stats()["cache"]["ckpt_deposits"] == 0:
+            pytest.skip("search finished before a bound-free snapshot")
+        handle = svc.submit(_req(reads + (extra,), cfg))
+        handle.result(timeout=300)
+        import time
+
+        time.sleep(0.2)  # give a (buggy) late deposit time to land
+        # a resumed search did not cover the space from scratch: its
+        # result and checkpoints stay out of the cache (fail-closed)
+        assert svc.stats()["cache"]["deposits"] == 1
+
+
+def test_file_store_serves_across_service_restarts(cache_env, tmp_path):
+    cache_env.setenv("WAFFLE_CACHE_DIR", str(tmp_path))
+    reads = _reads()
+    cfg = _cfg(min_count=2)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        want = svc.submit(_req(reads, cfg)).result(timeout=300)
+        _wait_deposits(svc, 1)
+    with ConsensusService(ServeConfig(workers=2)) as svc:
+        handle = svc.submit(_req(reads[::-1], cfg))
+        got = handle.result(timeout=300)
+        assert handle.status is JobStatus.CACHED
+        assert svc.stats()["cache"]["exact"] == 1
+    assert [c.sequence for c in got] == [c.sequence for c in want]
+
+
+def test_cache_off_by_default():
+    reads = _reads()
+    with ConsensusService(ServeConfig(workers=1)) as svc:
+        h = svc.submit(_req(reads, _cfg(min_count=2)))
+        h.result(timeout=300)
+        h2 = svc.submit(_req(reads, _cfg(min_count=2)))
+        h2.result(timeout=300)
+        stats = svc.stats()
+    assert "cache" not in stats
+    assert h2.status is JobStatus.DONE
+
+
+def _wait_deposits(svc, n, timeout_s=10.0):
+    """Deposits land after ``result()`` returns: wait for them."""
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if svc.stats().get("cache", {}).get("deposits", 0) >= n:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"cache never saw {n} deposit(s)")

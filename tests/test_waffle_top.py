@@ -256,6 +256,26 @@ def test_render_fleet_section(waffle_top):
     assert w1_row.split() == ["storm:w1", "-", "6", "0", "100", "-"]
 
 
+def test_render_cache_panel(waffle_top):
+    payload = _payload()
+    payload["stats"]["cache"] = {
+        "exact": 5, "certified": 2, "checkpoint": 1, "misses": 4,
+        "deposits": 4, "ckpt_deposits": 3, "certify_failed": 1,
+        "results": 4, "checkpoints": 3, "quarantined": 1,
+    }
+    out = waffle_top.render(payload, plain=True)
+    assert "cache: hits=8" in out
+    assert "exact=5" in out and "certified=2" in out and "ckpt=1" in out
+    assert "misses=4" in out and "quarantined=1" in out
+    assert "store=4r/3c" in out
+
+
+def test_render_cache_panel_absent_without_cache_stats(waffle_top):
+    # a cache-off payload (no "cache" in stats) renders no cache line
+    out = waffle_top.render(_payload(), plain=True)
+    assert "cache:" not in out
+
+
 def test_render_fleet_section_absent_without_fleet_field(waffle_top):
     # a pre-fleet door payload (workers but no "fleet") must render the
     # worker table only — no fleet rollup, no crash

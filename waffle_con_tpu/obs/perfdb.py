@@ -99,6 +99,12 @@ EVIDENCE_MODE_FIELDS: Dict[str, Tuple[str, ...]] = {
         "restarted_started", "wasted_work_s", "migration_jobs",
         "fleet",
     ),
+    "storm-cache": (
+        "parity", "jobs_per_s", "hit_rate", "cache_hits", "cache",
+        "exact_hits_dispatch_free", "checkpoint_hits_all_iters",
+        "checkpoint_jobs", "resumed_wall_total_s",
+        "scratch_wall_total_s", "statuses", "slo", "incidents",
+    ),
     "microbench": ("parity", "steps", "stop_code", "breakdown"),
     "north-star": ("parity", "vs_baseline", "breakdown"),
 }

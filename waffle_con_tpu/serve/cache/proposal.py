@@ -1,0 +1,103 @@
+"""Propose-then-verify: certify a cached near-miss consensus.
+
+A cached entry for read multiset ``R0`` holds the *complete* tied set
+of optimal consensuses at cost ``c0``.  For a new request over a
+superset ``R = R0 + extras``, every candidate ``s`` satisfies
+
+    total_R(s) = total_R0(s) + total_extras(s) >= total_R0(s) >= c0
+
+so the optimal cost for ``R`` is at least ``c0``.  If any cached
+consensus ``t`` achieves ``total_R(t) == c0`` under one exact scoring
+pass (every extra read at edit distance 0 against ``t``), then ``c0``
+IS the optimum for ``R``, and any optimal ``s`` for ``R`` must have
+``total_R0(s) == c0`` — i.e. ``s`` belongs to the cached tied set.
+The served answer ``{t in cached : total_R(t) == c0}`` is therefore
+the complete tied set for ``R``.  Anything short of equality degrades
+to a full search (mirroring the ``checkpoint_rejected`` path), so a
+wrong proposal can cost time but never parity.
+
+The completeness premise leans on the cached set being untruncated
+(``len(results) < max_return_size``) and on search reachability under
+the nomination gates (``min_count``/``min_af``) — the latter is not
+proven here, which is why certification is narrowly gated, defaults to
+refusing anything unusual, can be disabled outright with
+``WAFFLE_CACHE_PROPOSALS=0``, and is empirically byte-parity-checked
+in the bench/CI storm gates.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from waffle_con_tpu.models.consensus import Consensus
+from waffle_con_tpu.ops.scorer import PythonScorer
+from waffle_con_tpu.serve.cache import keys
+
+
+def eligible(request, entry: Dict) -> bool:
+    """Cheap gates before the (expensive) scoring pass: unseeded
+    ``single`` jobs, identical scoring config, no early termination,
+    and an untruncated cached tied set."""
+    if request.kind != "single" or entry.get("kind") != "single":
+        return False
+    if request.offsets is not None or entry.get("offsets") is not None:
+        return False
+    if entry.get("truncated"):
+        return False
+    config = request.config
+    if config is not None and config.allow_early_termination:
+        return False
+    if entry.get("config_fp") != keys.config_fingerprint(config):
+        return False
+    if not entry.get("result"):
+        return False
+    return True
+
+
+def certify(request, entry: Dict) -> Optional[List[Consensus]]:
+    """Score every cached candidate against the request's full read
+    set with the exact python oracle; return the complete tied set if
+    one candidate holds the cached optimal cost, else ``None``.
+
+    Caller must have checked :func:`eligible`."""
+    stored_reads = [bytes.fromhex(h) for h in entry.get("reads", ())]
+    extras = keys.multiset_extras(request.reads, stored_reads)
+    if extras is None:
+        return None
+
+    if request.config is None:
+        from waffle_con_tpu.config import CdwfaConfig
+
+        config = CdwfaConfig()
+    else:
+        config = request.config
+    cost = config.consensus_cost
+
+    cached = entry["result"]
+    totals0 = {sum(item["scores"]) for item in cached}
+    if len(totals0) != 1:  # a tied set with unequal totals is corrupt
+        return None
+    c0 = totals0.pop()
+
+    candidates = sorted(
+        base64.b64decode(item["sequence"]) for item in cached
+    )
+    reads = [bytes(r) for r in request.reads]
+    scorer = PythonScorer(reads, config)
+    active = np.ones(len(reads), dtype=bool)
+    served: List[Consensus] = []
+    for seq in candidates:
+        handle = scorer.root(active)
+        for i in range(len(seq)):
+            scorer.push(handle, seq[: i + 1])
+        eds = scorer.finalized_eds(handle, seq)
+        scorer.free(handle)
+        scores = [cost.apply(int(e)) for e in eds]
+        if sum(scores) == c0:
+            served.append(Consensus(seq, cost, scores))
+    if not served:
+        return None
+    return served

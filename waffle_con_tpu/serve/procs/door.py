@@ -260,6 +260,14 @@ class ProcFrontDoor:
         self._routed_at: Dict[int, float] = {}
         self._stats_published_at = 0.0
         self._stopping = False
+        from waffle_con_tpu.serve import cache as serve_cache
+
+        #: door-side consensus cache (None when WAFFLE_CACHE is off):
+        #: exact/certified hits answer before SUBMIT serialization,
+        #: superset hits ride a cached checkpoint to the worker
+        self._cache = serve_cache.ConsensusCache.from_env(
+            f"{self.config.name}.door"
+        )
         self._tmpdir = tempfile.mkdtemp(prefix="waffle-procs-")
         self._socket_path = os.path.join(self._tmpdir, "door.sock")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -298,6 +306,11 @@ class ProcFrontDoor:
         env = dict(os.environ)
         # the door is the only stats publisher
         env.pop("WAFFLE_STATS_FILE", None)
+        # the door owns the consensus cache: a worker-side cache would
+        # be redundant (the door short-circuits first) and a shared
+        # WAFFLE_CACHE_DIR would race N manifest writers
+        env.pop("WAFFLE_CACHE", None)
+        env.pop("WAFFLE_CACHE_DIR", None)
         # with incident forwarding on the door is also the only
         # incident dumper: the worker forwards its flight dump over the
         # INCIDENT frame and the door re-ingests it with attribution —
@@ -560,6 +573,26 @@ class ProcFrontDoor:
             self._counts["submitted"] += 1
         if checkpoint is not None:
             handle._attach_checkpoint(checkpoint)
+        elif self._cache is not None:
+            # the short-circuit answers before any SUBMIT frame is even
+            # encoded: an exact/certified hit never costs serialization,
+            # routing, or a worker slot
+            from waffle_con_tpu.serve import cache as serve_cache
+
+            hit = self._cache.lookup(
+                request, trace_id=handle.trace.trace_id
+            )
+            if isinstance(hit, serve_cache.CacheHit):
+                status = (
+                    JobStatus.CACHED if hit.tier == "exact"
+                    else JobStatus.CERTIFIED
+                )
+                handle._finish(status, result=hit.result)
+                self._publish_stats()
+                return handle
+            if isinstance(hit, serve_cache.CheckpointHit):
+                handle._attach_checkpoint(hit.checkpoint)
+                handle._resumed_from_checkpoint = True
         try:
             self._queue.put(handle)
         except (ServiceOverloaded, ServiceClosed):
@@ -701,6 +734,10 @@ class ProcFrontDoor:
             # never decodes it (the worker validates CRC/version and
             # degrades to a fresh search on rejection)
             payload["checkpoint"] = checkpoint
+            # a job that starts from any checkpoint (client resume,
+            # cache superset hit, migration) must not deposit back into
+            # the cache: its search did not cover the space from scratch
+            handle._resumed_from_checkpoint = True
         trace_obj = self._trace_dispatch(handle)
         if trace_obj is not None:
             payload["trace"] = trace_obj
@@ -968,6 +1005,14 @@ class ProcFrontDoor:
             worker.ckpt_bytes += size
         if handle is not None:
             handle._attach_checkpoint(data)
+            if self._cache is not None:
+                from waffle_con_tpu.serve import cache as serve_cache
+
+                # bound-free snapshots double as the job's cache
+                # deposit candidate — only those resume a read
+                # superset exactly
+                if serve_cache.resumable_wire(data):
+                    handle._cache_ckpt = data
         if obs_metrics.metrics_enabled():
             reg = obs_metrics.registry()
             labels = {"service": self.config.name, "worker": worker.name}
@@ -993,6 +1038,19 @@ class ProcFrontDoor:
             self._observe_phases(handle)
             return
         handle._finish(JobStatus.DONE, result=result)
+        if (self._cache is not None
+                and not getattr(handle, "_resumed_from_checkpoint", False)):
+            try:
+                # the RESULT frame already carries the wire JSON — the
+                # deposit costs no re-encoding; the handle's latest
+                # bound-free CHECKPOINT frame (if any) feeds the
+                # superset tier
+                self._cache.deposit_result(handle.request, obj["result"])
+                ckpt = getattr(handle, "_cache_ckpt", None)
+                if ckpt is not None:
+                    self._cache.deposit_checkpoint(handle.request, ckpt)
+            except Exception:  # noqa: BLE001 - cache never fails a job
+                pass
         if handle.latency_s is not None:
             obs_slo.observe_job(handle.latency_s)
         self._trace_settle(handle, "done")
@@ -1290,7 +1348,7 @@ class ProcFrontDoor:
                 self._routed_at.pop(job_id, None)
             counts = dict(self._counts)
         workers = self.worker_stats()
-        return {
+        out = {
             "jobs": counts,
             "queue_depth": self._queue.depth(),
             "aged_pops": self._queue.aged_pops,
@@ -1309,6 +1367,9 @@ class ProcFrontDoor:
                 "span_events": sum(w["span_events"] for w in workers),
             },
         }
+        if self._cache is not None:
+            out["cache"] = self._cache.stats()
+        return out
 
     def _publish_stats(self, force: bool = False) -> None:
         """Front-door-owned ``WAFFLE_STATS_FILE`` publication (same

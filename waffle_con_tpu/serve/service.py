@@ -178,10 +178,16 @@ class ConsensusService:
         self._counts = {
             "submitted": 0, "rejected": 0, "done": 0, "failed": 0,
             "cancelled": 0, "expired": 0, "mesh_placed": 0,
+            "cached": 0, "certified": 0,
         }
         self._ckpt_counts = {
             "snapshots": 0, "bytes": 0, "resumed": 0, "rejected": 0,
         }
+        from waffle_con_tpu.serve import cache as serve_cache
+
+        #: content-addressed consensus cache, or None when WAFFLE_CACHE
+        #: is off (the default) — see waffle_con_tpu/serve/cache/
+        self._cache = serve_cache.ConsensusCache.from_env(self.config.name)
         if autostart:
             self.start()
 
@@ -261,6 +267,30 @@ class ConsensusService:
             self._next_id += 1
         if checkpoint is not None:
             handle._attach_checkpoint(checkpoint)
+        elif self._cache is not None:
+            # content-addressed cache: an exact (or certified) hit is
+            # finalized here without ever touching the admission queue;
+            # a checkpoint-superset hit rides the normal path but
+            # resumes from the cached frontier instead of scratch
+            from waffle_con_tpu.serve import cache as serve_cache
+
+            hit = self._cache.lookup(
+                request, trace_id=handle.trace.trace_id
+            )
+            if isinstance(hit, serve_cache.CacheHit):
+                status = (
+                    JobStatus.CACHED if hit.tier == "exact"
+                    else JobStatus.CERTIFIED
+                )
+                handle._finish(status, result=hit.result)
+                with self._lock:
+                    self._counts["submitted"] += 1
+                    self._handles.append(handle)
+                self._account(handle, status.value)
+                return handle
+            if isinstance(hit, serve_cache.CheckpointHit):
+                handle._attach_checkpoint(hit.checkpoint)
+                handle._from_cache_checkpoint = True
         try:
             self._queue.put(handle)
         except ServiceOverloaded:
@@ -331,7 +361,8 @@ class ConsensusService:
         with self._lock:
             counts = dict(self._counts)
         finished = (counts["done"] + counts["failed"]
-                    + counts["cancelled"] + counts["expired"])
+                    + counts["cancelled"] + counts["expired"]
+                    + counts["cached"] + counts["certified"])
         return max(0, counts["submitted"] - finished)
 
     # -- worker --------------------------------------------------------
@@ -422,6 +453,7 @@ class ConsensusService:
                 report=getattr(engine, "last_search_report", None),
             )
             self._account(handle, "done")
+            self._deposit(handle, result)
             if profile:
                 self._record_placement_outcome(
                     handle, time.monotonic() - job_t0, phases_before
@@ -441,6 +473,32 @@ class ConsensusService:
             self._dispatcher.job_finished()
             obs_trace.set_current_context(prev_ctx)
 
+    def _deposit(self, handle: JobHandle, result) -> None:
+        """Feed a finished job back into the consensus cache: its wire
+        result under the canonical key, plus its last *bound-free*
+        mid-search checkpoint for superset resume (a bound-tightened
+        snapshot prunes with subset-only costs and must never seed a
+        superset search).  Jobs that themselves resumed from a
+        checkpoint never deposit (their search did not cover the full
+        space from scratch — fail-closed for parity).  Cache IO never
+        fails a job."""
+        if self._cache is None:
+            return
+        if getattr(handle, "_resumed_from_checkpoint", False):
+            return
+        try:
+            from waffle_con_tpu.serve.procs import wire
+
+            self._cache.deposit_result(
+                handle.request,
+                wire.encode_result(handle.request.kind, result),
+            )
+            last = getattr(handle, "_cache_ckpt", None)
+            if last is not None:
+                self._cache.deposit_checkpoint(handle.request, last)
+        except Exception:  # noqa: BLE001 - cache must never fail a job
+            pass
+
     def _make_engine(self, handle: JobHandle):
         """Build the job's engine — resuming from the handle's attached
         checkpoint when one is present (migration / incremental-read
@@ -458,19 +516,45 @@ class ConsensusService:
                         f"{handle.request.kind} job cannot resume a "
                         f"{checkpoint.kind!r} checkpoint"
                     )
-                engine = ckpt_mod.resume_engine(checkpoint)
+                extras = self._checkpoint_extras(handle.request, checkpoint)
+                engine = ckpt_mod.resume_engine(
+                    checkpoint, extra_reads=extras
+                )
             except ckpt_mod.CheckpointRejected as exc:
                 self._record_ckpt_rejection(handle, exc)
             else:
+                handle._resumed_from_checkpoint = True
                 with self._lock:
                     self._ckpt_counts["resumed"] += 1
                 events.record(
                     "job_resumed", job_id=handle.job_id,
                     job_kind=handle.request.kind,
                     service=self.config.name,
+                    extra_reads=len(extras),
                 )
                 return engine
         return _build_engine(handle.request)
+
+    @staticmethod
+    def _checkpoint_extras(request: JobRequest, checkpoint) -> tuple:
+        """The request reads missing from a checkpoint's read multiset
+        (the incremental/superset resume seam): the engine restores the
+        recorded frontier and joins these at offset 0.  Empty when the
+        multisets match (plain resume) or whenever the overlap cannot
+        be established — never a reason to reject the checkpoint."""
+        if request.kind != "single" or request.offsets is not None:
+            return ()
+        try:
+            from waffle_con_tpu.models import checkpoint as ckpt_mod
+            from waffle_con_tpu.serve.cache import keys as cache_keys
+
+            body_reads = [
+                ckpt_mod.unb64(r) for r in checkpoint.body["reads"]
+            ]
+            extras = cache_keys.multiset_extras(request.reads, body_reads)
+        except Exception:  # noqa: BLE001 - malformed body: plain resume
+            return ()
+        return extras or ()
 
     def _record_ckpt_rejection(self, handle: JobHandle, exc) -> None:
         """Account one rejected checkpoint (counter, event log, typed
@@ -496,9 +580,18 @@ class ConsensusService:
 
     def _deliver_checkpoint(self, handle: JobHandle, checkpoint) -> None:
         """Controller snapshot hook: attach the wire form to the handle
-        (which forwards it to any ``on_checkpoint`` sink) and count."""
+        (which forwards it to any ``on_checkpoint`` sink) and count.
+        Bound-free snapshots are also remembered as the job's cache
+        deposit candidate — only those resume a read superset exactly
+        (see :func:`waffle_con_tpu.serve.cache.resumable_wire`)."""
         size = checkpoint.byte_size()
-        handle._attach_checkpoint(checkpoint.to_wire())
+        wire_ckpt = checkpoint.to_wire()
+        handle._attach_checkpoint(wire_ckpt)
+        if self._cache is not None:
+            from waffle_con_tpu.serve import cache as serve_cache
+
+            if serve_cache.resumable_wire(wire_ckpt):
+                handle._cache_ckpt = wire_ckpt
         with self._lock:
             self._ckpt_counts["snapshots"] += 1
             self._ckpt_counts["bytes"] += size
@@ -639,7 +732,8 @@ class ConsensusService:
         with self._lock:
             counts = dict(self._counts)
         finished = (counts["done"] + counts["failed"]
-                    + counts["cancelled"] + counts["expired"])
+                    + counts["cancelled"] + counts["expired"]
+                    + counts["cached"] + counts["certified"])
         return max(0, counts["submitted"] - finished - self._queue.depth())
 
     # -- introspection -------------------------------------------------
@@ -650,7 +744,7 @@ class ConsensusService:
         with self._lock:
             counts = dict(self._counts)
             ckpt_counts = dict(self._ckpt_counts)
-        return {
+        payload = {
             "jobs": counts,
             "checkpoints": ckpt_counts,
             "queue_depth": self._queue.depth(),
@@ -658,3 +752,6 @@ class ConsensusService:
             "dispatch": self._dispatcher.stats(),
             "ragged": ops_ragged.arena_stats(self._arena),
         }
+        if self._cache is not None:
+            payload["cache"] = self._cache.stats()
+        return payload

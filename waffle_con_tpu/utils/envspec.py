@@ -190,6 +190,25 @@ KNOBS: Dict[str, EnvKnob] = dict((
        "Worker: forward every post-dedupe flight incident to the door "
        "as an INCIDENT frame (door re-ingests with worker attribution "
        "and fleet-level dedupe); `0` keeps incidents worker-local"),
+    # -- consensus cache (serve/cache) --------------------------------
+    _k("WAFFLE_CACHE", "flag", "unset (off)",
+       "Serving: content-addressed consensus cache at admission -- "
+       "exact duplicates answer from the result store, read-superset "
+       "submissions resume cached checkpoints or certify cached "
+       "consensuses (see serve/cache/)"),
+    _k("WAFFLE_CACHE_MAX", "int", "256",
+       "Consensus cache: in-memory result-store entry cap (LRU)"),
+    _k("WAFFLE_CACHE_CKPTS", "int", "64",
+       "Consensus cache: checkpoint-store entry cap for superset "
+       "resume (LRU)"),
+    _k("WAFFLE_CACHE_PROPOSALS", "flag", "1 (on)",
+       "Consensus cache: certify cached near-miss consensuses with "
+       "one exact scoring pass (propose-then-verify tier); 0 keeps "
+       "only the exact-hit and checkpoint-superset tiers"),
+    _k("WAFFLE_CACHE_DIR", "path", "unset",
+       "Consensus cache: optional on-disk result store directory -- "
+       "entries are sha256-sealed via MANIFEST.json; corrupt files "
+       "quarantine to _quarantine/ and are never served"),
 ))
 
 
