@@ -327,7 +327,8 @@ def _emit(out, perfdb_kind=None):
                   "steps_per_s", "gang_occupancy",
                   "gang_commit_rate", "migrated", "restarted_started",
                   "wasted_work_s", "migration_jobs", "hit_rate",
-                  "cache_hits", "checkpoint_jobs"):
+                  "cache_hits", "checkpoint_jobs", "host_round_trips",
+                  "syms_per_dispatch", "commits_per_dispatch"):
             v = out.get(k)
             if v is None and isinstance(breakdown, dict):
                 v = breakdown.get(k)
@@ -367,6 +368,34 @@ def _append_mixed_w_record(out):
         )
         path = perfdb.append_record(rec)
         print(f"perfdb: appended serve-mix-mixed-w record to {path}",
+              file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 - history is best-effort
+        print(f"perfdb append failed: {exc!r}", file=sys.stderr)
+
+
+def _append_microbench_mega_record(out):
+    """Second perfdb line for ``--microbench``: the MEGASTEP hot-loop
+    throughput lands as its own ``microbench-mega`` record (steps/s,
+    commits-per-dispatch, round trips) so ``perf_report.py --check``
+    can trend/gate it independently of the plain run_extend number."""
+    from waffle_con_tpu.obs import perfdb
+
+    mega = out.get("mega")
+    if not isinstance(mega, dict):
+        return
+    try:
+        rec = perfdb.make_record(
+            "microbench-mega",
+            mega["metric"],
+            float(mega.get("steps_per_s") or 0.0),
+            "steps/s",
+            platform=out.get("device_platform", "unknown"),
+            parity=mega.get("parity"),
+            syms_per_dispatch=mega.get("syms_per_dispatch"),
+            host_round_trips=mega.get("host_round_trips"),
+        )
+        path = perfdb.append_record(rec)
+        print(f"perfdb: appended microbench-mega record to {path}",
               file=sys.stderr)
     except Exception as exc:  # noqa: BLE001 - history is best-effort
         print(f"perfdb append failed: {exc!r}", file=sys.stderr)
@@ -467,13 +496,15 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
     )
     out = {
         "metric": f"consensus_{num_reads}x{seq_len}_wall_s",
-        "value": round(tpu_time, 4),
+        "value": round(tpu_min, 4),
         "value_min": round(tpu_min, 4),
         "value_median": round(tpu_time, 4),
+        "wall_median_s": round(tpu_time, 4),
+        "iter_walls_s": [round(t, 4) for t in times],
         "n_iters": len(times),
         "unit": "s",
         "mode": "north-star",
-        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "vs_baseline": round(cpu_time / tpu_min, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(
             [(c.sequence, c.scores) for c in tpu_results] == cpu_results
@@ -488,6 +519,12 @@ def bench_single(num_reads, seq_len, error_rate, trace=None, iters=5,
             "device_dispatches": dispatches,
             "run_extend_calls": counters.get("run_calls", 0),
             "run_extend_steps": counters.get("run_steps", 0),
+            "run_mega_calls": counters.get("run_mega_calls", 0),
+            "commits_per_dispatch": round(
+                counters.get("run_steps", 0)
+                / max(counters.get("run_calls", 0), 1), 2
+            ),
+            "host_round_trips": counters.get("host_round_trips", 0),
             "run_pallas_calls": counters.get("run_pallas_calls", 0),
             "push_calls": counters.get("push_calls", 0),
             "arena_calls": counters.get("arena_calls", 0),
@@ -531,6 +568,13 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
     ``min_count = reads/4`` the whole sequence is one unambiguous run,
     so the appended bytes must equal the generator's ground truth — at
     every measured K.
+
+    The MEGASTEP run path is measured alongside (same geometry, same
+    configured K, ``run_extend(..., mega=True)``): its steps/s lands in
+    a second ``microbench-mega`` perfdb record, and both modes report
+    ``host_round_trips`` (blocking device syncs per engagement) and
+    ``syms_per_dispatch`` (committed symbols per run dispatch) — the
+    two quantities the megastep exists to move.
     """
     import os
 
@@ -555,10 +599,11 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
     scorer = JaxScorer(reads, cfg)
     budget = 2**31 - 1
 
-    def engage():
+    def engage(mega):
         h = scorer.root(np.ones(num_reads, dtype=bool))
         steps, code, appended, stats, _recs = scorer.run_extend(
-            h, b"", budget, budget, 0, min_count, False, seq_len
+            h, b"", budget, budget, 0, min_count, False, seq_len,
+            mega=mega,
         )
         # force the deferred-sync fetch inside the timed window so the
         # gated number includes the full result cost, not just control
@@ -566,37 +611,49 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
         scorer.free(h)
         return steps, code, appended
 
-    def measure(k):
-        """(steps/s, parity, commit_rate, steps, code, compile_s) at K=k."""
+    def measure(k, mega=False):
+        """Timed engagements at K=k (optionally on the megastep path):
+        returns a dict of steps/s, parity, commit/dispatch accounting."""
         prev = envspec.get_raw("WAFFLE_RUN_COLS")
         os.environ["WAFFLE_RUN_COLS"] = str(k)
         try:
             compile_start = time.perf_counter()
-            steps, code, appended = engage()  # warm-up compiles this K
+            steps, code, appended = engage(mega)  # warm-up compiles this K
             compile_s = time.perf_counter() - compile_start
             parity = appended == truth
             it0 = scorer.counters["run_iters"]
             sc0 = scorer.counters["run_spec_cols"]
             st0 = scorer.counters["run_steps"]
+            rc0 = scorer.counters["run_calls"]
+            rt0 = scorer.counters["host_round_trips"]
             best = None
             for _ in range(max(1, iters)):
                 t0 = time.perf_counter()
-                steps, code, appended = engage()
+                steps, code, appended = engage(mega)
                 dt = time.perf_counter() - t0
                 if best is None or dt < best:
                     best = dt
                 parity = parity and appended == truth
             spec = scorer.counters["run_spec_cols"] - sc0
-            commit_rate = (
-                (scorer.counters["run_steps"] - st0) / spec if spec else 1.0
-            )
-            cols_per_iter = spec / max(
-                scorer.counters["run_iters"] - it0, 1
-            )
-            return (
-                steps / max(best, 1e-9), parity, commit_rate,
-                cols_per_iter, steps, code, best, compile_s,
-            )
+            committed = scorer.counters["run_steps"] - st0
+            calls = scorer.counters["run_calls"] - rc0
+            n = max(1, iters)
+            return {
+                "steps_per_s": steps / max(best, 1e-9),
+                "parity": parity,
+                "commit_rate": committed / spec if spec else 1.0,
+                "cols_per_iter": spec / max(
+                    scorer.counters["run_iters"] - it0, 1
+                ),
+                "steps": steps,
+                "code": code,
+                "best": best,
+                "compile_s": compile_s,
+                "syms_per_dispatch": committed / max(calls, 1),
+                "host_round_trips": round(
+                    (scorer.counters["host_round_trips"] - rt0) / n, 2
+                ),
+            }
         finally:
             if prev is None:
                 os.environ.pop("WAFFLE_RUN_COLS", None)
@@ -605,27 +662,45 @@ def bench_microbench(num_reads, seq_len, error_rate, iters=3):
 
     cols = _run_cols()
     overlap0 = host_overlap_total()
-    (base_sps, base_parity, _, _, _, _, _, base_compile_s) = measure(1)
-    (steps_per_s, parity, commit_rate, cols_per_iter, steps, code, best,
-     compile_time) = measure(cols)
-    parity = parity and base_parity
+    base = measure(1)
+    plain = measure(cols)
+    mega = measure(cols, mega=True)
+    parity = plain["parity"] and base["parity"] and mega["parity"]
     return {
         "metric": f"microbench_run_extend_{num_reads}x{seq_len}_steps_per_s",
-        "value": round(steps_per_s, 1),
+        "value": round(plain["steps_per_s"], 1),
         "unit": "steps/s",
         "mode": "microbench",
         "n_iters": max(1, iters),
-        "steps": int(steps),
-        "stop_code": int(code),
-        "best_engagement_s": round(best, 4),
+        "steps": int(plain["steps"]),
+        "stop_code": int(plain["code"]),
+        "best_engagement_s": round(plain["best"], 4),
         "parity": bool(parity),
+        "mega": {
+            "metric": (
+                f"microbench_run_mega_{num_reads}x{seq_len}_steps_per_s"
+            ),
+            "steps_per_s": round(mega["steps_per_s"], 1),
+            "syms_per_dispatch": round(mega["syms_per_dispatch"], 1),
+            "host_round_trips": mega["host_round_trips"],
+            "stop_code": int(mega["code"]),
+            "parity": bool(mega["parity"]),
+            "warmup_incl_compile_s": round(mega["compile_s"], 2),
+        },
         "breakdown": {
-            "warmup_incl_compile_s": round(compile_time + base_compile_s, 2),
+            "warmup_incl_compile_s": round(
+                plain["compile_s"] + base["compile_s"], 2
+            ),
             "initial_band": band,
             "run_cols": cols,
-            "steps_per_s_k1": round(base_sps, 1),
-            "cols_per_iter": round(cols_per_iter, 2),
-            "spec_commit_rate": round(commit_rate, 4),
+            "steps_per_s_k1": round(base["steps_per_s"], 1),
+            "steps_per_s_mega": round(mega["steps_per_s"], 1),
+            "cols_per_iter": round(plain["cols_per_iter"], 2),
+            "spec_commit_rate": round(plain["commit_rate"], 4),
+            "syms_per_dispatch": round(plain["syms_per_dispatch"], 1),
+            "syms_per_dispatch_mega": round(mega["syms_per_dispatch"], 1),
+            "host_round_trips": plain["host_round_trips"],
+            "host_round_trips_mega": mega["host_round_trips"],
             "host_overlap_s": round(host_overlap_total() - overlap0, 4),
             "run_pallas_calls": scorer.counters.get("run_pallas_calls", 0),
             "runtime_events": _runtime_events(),
@@ -699,19 +774,24 @@ def bench_dual(num_reads, seq_len, error_rate, iters=5, trace_out=None):
     )
     out = {
         "metric": f"dual_{num_reads}x{seq_len}_wall_s",
-        "value": round(tpu_time, 4),
+        "value": round(tpu_min, 4),
         "value_min": round(tpu_min, 4),
         "value_median": round(tpu_time, 4),
+        "wall_median_s": round(tpu_time, 4),
+        "iter_walls_s": [round(t, 4) for t in times],
         "n_iters": len(times),
         "unit": "s",
         "mode": "dual",
-        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "vs_baseline": round(cpu_time / tpu_min, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(tpu_results == cpu_results),
         "is_dual": bool(tpu_results and tpu_results[0].is_dual()),
         "breakdown": {
             "run_dual_calls": counters.get("run_dual_calls", 0),
             "run_dual_steps": counters.get("run_dual_steps", 0),
+            "run_mega_calls": counters.get("run_mega_calls", 0),
+            "run_dual_mega_calls": counters.get("run_dual_mega_calls", 0),
+            "host_round_trips": counters.get("host_round_trips", 0),
             "run_calls": counters.get("run_calls", 0),
             "run_steps": counters.get("run_steps", 0),
             "arena_calls": counters.get("arena_calls", 0),
@@ -847,13 +927,15 @@ def bench_priority(num_reads, seq_len, error_rate, iters=5, trace_out=None):
 
     out = {
         "metric": f"priority_{num_reads}x{seq_len}_wall_s",
-        "value": round(tpu_time, 4),
+        "value": round(tpu_min, 4),
         "value_min": round(tpu_min, 4),
         "value_median": round(tpu_time, 4),
+        "wall_median_s": round(tpu_time, 4),
+        "iter_walls_s": [round(t, 4) for t in times],
         "n_iters": len(times),
         "unit": "s",
         "mode": "priority",
-        "vs_baseline": round(cpu_time / tpu_time, 3),
+        "vs_baseline": round(cpu_time / tpu_min, 3),
         "cpu_baseline_s": round(cpu_time, 4),
         "parity": bool(tpu_result == cpu_result),
         "groups": len(tpu_result.consensuses),
@@ -2400,6 +2482,14 @@ def main() -> None:
         "parity cross-check passed (the CI regression gate)",
     )
     parser.add_argument(
+        "--assert-mega-floor", type=float, default=None, metavar="S",
+        dest="mega_floor",
+        help="with --microbench: exit 1 unless the MEGASTEP path's "
+        "steps/s >= S, its parity held, and its host_round_trips per "
+        "engagement is strictly below the plain path's (the megastep "
+        "CI regression gate)",
+    )
+    parser.add_argument(
         "--tie-heavy", action="store_true", dest="tie_heavy",
         help="tie-heavy worst case: the 2%% error single-engine grid "
         "shape (4x10000x8 full, smaller under --smoke) plus one dual "
@@ -2547,6 +2637,7 @@ def main() -> None:
         )
         out["device_platform"] = _current_platform()
         _emit(out, perfdb_kind="microbench")
+        _append_microbench_mega_record(out)
         if args.steps_floor is not None:
             ok = out["parity"] and out["value"] >= args.steps_floor
             if not ok:
@@ -2554,6 +2645,33 @@ def main() -> None:
                     f"FAIL: steps/s {out['value']} < floor "
                     f"{args.steps_floor} or parity lost "
                     f"(parity={out['parity']})",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        if args.mega_floor is not None:
+            mega = out.get("mega", {})
+            ok = mega.get("parity", False) and (
+                mega.get("steps_per_s", 0) >= args.mega_floor
+            )
+            if not ok:
+                print(
+                    f"FAIL: mega steps/s {mega.get('steps_per_s')} < "
+                    f"floor {args.mega_floor} or mega parity lost "
+                    f"(parity={mega.get('parity')})",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+            # the megastep's reason to exist: strictly fewer blocking
+            # host syncs per engagement than the plain stepping path
+            plain_rt = out["breakdown"].get("host_round_trips")
+            mega_rt = mega.get("host_round_trips")
+            if not (
+                plain_rt is not None and mega_rt is not None
+                and mega_rt < plain_rt
+            ):
+                print(
+                    f"FAIL: mega host_round_trips {mega_rt} not "
+                    f"strictly below plain {plain_rt}",
                     file=sys.stderr,
                 )
                 sys.exit(1)

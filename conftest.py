@@ -26,6 +26,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 # cold-cache compile time for zero coverage the explicit-K tests
 # don't already provide.
 os.environ.setdefault("WAFFLE_RUN_COLS", "1")
+# Same reasoning for the megastep: the production default (on, M=8)
+# would route every jax engine test through the M-block mega kernel —
+# a different jit specialization per geometry than the plain path the
+# rest of the suite compiles — blowing the tier-1 wall-clock budget
+# for coverage tests/test_megastep.py (which sets WAFFLE_MEGASTEP
+# itself, per exit class and M×K combination) already provides; ci.sh
+# runs the microbench gate and bench smokes at the production default.
+os.environ.setdefault("WAFFLE_MEGASTEP", "0")
 
 import jax  # noqa: E402
 

@@ -73,6 +73,13 @@ def render_trend(records, limit):
               f"{_fmt(latest):>9s} {vs:>8s}")
 
 
+#: minimum same-platform, same-metric prior records before the rolling
+#: baseline gates: a 1–2 record "baseline" is one noisy run judging
+#: another, so below this the kind is reported (not gated) with an
+#: explicit ``no-baseline (n=<k>)`` line
+MIN_BASELINE_N = 3
+
+
 def check(records, args, kind=None, floor=None):
     kind = kind if kind is not None else args.kind
     floor = floor if floor is not None else args.floor
@@ -80,8 +87,8 @@ def check(records, args, kind=None, floor=None):
             if isinstance(r.get("value"), (int, float))
             and (args.metric is None or r.get("metric") == args.metric)]
     if not recs:
-        print(f"perfdb check: no {kind!r} records in "
-              f"{args.db or perfdb.default_path()} — nothing to gate "
+        print(f"perfdb check: {kind!r} no-baseline (n=0) — no records "
+              f"in {args.db or perfdb.default_path()}, nothing to gate "
               f"(first run seeds the db)")
         return 0
     latest = recs[-1]
@@ -92,7 +99,8 @@ def check(records, args, kind=None, floor=None):
     prior = [r for r in recs[:-1]
              if r.get("platform") == latest.get("platform")
              and r.get("metric") == latest.get("metric")]
-    base = perfdb.rolling_baseline(prior, window=args.window)
+    base = (perfdb.rolling_baseline(prior, window=args.window)
+            if len(prior) >= MIN_BASELINE_N else None)
     unit = latest.get("unit", "")
     where = (f"{latest.get('kind')}/{latest.get('metric')} on "
              f"{latest.get('platform', '?')}")
@@ -112,7 +120,9 @@ def check(records, args, kind=None, floor=None):
         ok = ok and value >= allowed
     else:
         print(f"perfdb check ok: {where} latest {_fmt(value)} {unit}, "
-              f"no prior history (floor {floor} passed)")
+              f"no-baseline (n={len(prior)}) — rolling gate needs >= "
+              f"{MIN_BASELINE_N} same-platform records"
+              f" (floor {floor} passed)")
     return 0 if ok else 1
 
 

@@ -146,6 +146,26 @@ def test_jax_roundtrip_mid_gang_mid_kblock(kind, m, k, monkeypatch):
 
 
 @pytest.mark.parametrize("kind", ["single", "dual", "priority"])
+def test_jax_roundtrip_mid_megastep(kind, monkeypatch):
+    """Device backend with the MEGASTEP path engaged and a tiny
+    per-dispatch commit budget (``WAFFLE_MEGA_SYMS=7``): every megastep
+    caps mid-run (stop code 4) and the engine re-engages from the
+    partial trail, so snapshots land between megastep dispatches with
+    multi-symbol committed stretches in flight.  A snapshot resolves at
+    the megastep exit boundary — the device-committed trail is fully
+    replayed into the node before the poll — so resume is
+    byte-identical to the uninterrupted search."""
+    monkeypatch.setenv("WAFFLE_MEGASTEP", "1")
+    monkeypatch.setenv("WAFFLE_RUN_COLS", "4")
+    monkeypatch.setenv("WAFFLE_MEGA_BLOCKS", "4")
+    monkeypatch.setenv("WAFFLE_MEGA_SYMS", "7")
+    ref = _cached_snapshots(kind, "python")[0]
+    _jax_ref, snaps = _run_with_snapshots(kind, "jax")
+    assert _jax_ref == ref, "jax megastep diverged from the python oracle"
+    assert _resume(snaps[len(snaps) // 2]).consensus() == ref
+
+
+@pytest.mark.parametrize("kind", ["single", "dual", "priority"])
 def test_empty_extra_reads_is_plain_resume(kind):
     ref, snaps = _cached_snapshots(kind, "python")
     assert _resume(snaps[len(snaps) // 2], extra_reads=[]).consensus() \

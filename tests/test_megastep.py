@@ -1,0 +1,281 @@
+"""MEGASTEP parity fuzz: the device-resident run-until-ambiguous path
+(``WAFFLE_MEGASTEP``, ``run_extend(..., mega=True)``) must be
+byte-identical to plain stepping on every engine, at every exit
+reason, and under every knob combination — the megastep composes the
+SAME masked per-column substep M×K times per device iteration, so any
+divergence is a correctness bug, not a tuning artifact.
+
+Families:
+
+* engine-level fuzz (single / dual / priority) mega-on vs mega-off vs
+  the python oracle, across seeds and error rates that traverse the
+  ambiguity classes (clean runs, dirty-vote forks, record absorption);
+* M×K composition: ``WAFFLE_MEGA_BLOCKS`` x ``WAFFLE_RUN_COLS`` in
+  {1,4}x{1,4} — block composition must not move a single commit;
+* forced-i16 band state (``WAFFLE_XLA_I16=1``) under mega;
+* mid-megastep stop codes: a tiny ``WAFFLE_MEGA_SYMS`` budget caps
+  every dispatch mid-run (stop code 4) and the engine re-engages from
+  the partial trail;
+* band overflow (stop code 5) mid-megastep via a deliberately small
+  ``initial_band``;
+* the capability seam: ``run_mega`` is property-gated (None when
+  ``WAFFLE_MEGASTEP=0``), survives ``fast_paths`` snapshots, and the
+  supervisor retries a faulted megastep as plain stepping without
+  demotion;
+* the point of it all: strictly fewer blocking host round trips per
+  search than plain stepping, with the commit trail unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.models.priority_consensus import PriorityConsensusDWFA
+from waffle_con_tpu.utils.example_gen import corrupt, generate_test
+
+# ------------------------------------------------------------ helpers
+
+
+def _cfg(backend, min_count=2, **over):
+    b = CdwfaConfigBuilder().backend(backend).min_count(min_count)
+    for k, v in over.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _set_mega(monkeypatch, mega, cols="1", blocks="1", syms=None,
+              i16=None):
+    monkeypatch.setenv("WAFFLE_MEGASTEP", "1" if mega else "0")
+    monkeypatch.setenv("WAFFLE_RUN_COLS", cols)
+    monkeypatch.setenv("WAFFLE_MEGA_BLOCKS", blocks)
+    if syms is not None:
+        monkeypatch.setenv("WAFFLE_MEGA_SYMS", syms)
+    if i16 is not None:
+        monkeypatch.setenv("WAFFLE_XLA_I16", i16)
+
+
+def _single(reads, backend="jax", min_count=2, **over):
+    e = ConsensusDWFA(_cfg(backend, min_count, **over))
+    for r in reads:
+        e.add_sequence(r)
+    res = [(c.sequence, c.scores) for c in e.consensus()]
+    return res, dict(e.last_search_stats.get("scorer_counters", {}))
+
+
+def _dual(reads, backend="jax", min_count=2):
+    e = DualConsensusDWFA(_cfg(backend, min_count))
+    for r in reads:
+        e.add_sequence(r)
+    return e.consensus(), dict(
+        e.last_search_stats.get("scorer_counters", {})
+    )
+
+
+def _dual_reads(seq_len=80, n_per=4, er=0.03, seed=4000):
+    rng = np.random.default_rng(seed)
+    truth, reads1 = generate_test(4, seq_len, n_per, er, seed=seed + 1)
+    h2 = bytearray(truth)
+    for pos in rng.choice(seq_len, size=2, replace=False):
+        h2[pos] = (h2[pos] + 1 + int(rng.integers(3))) % 4
+    return list(reads1) + [
+        corrupt(bytes(h2), er, np.random.default_rng(seed + 2 + i))
+        for i in range(n_per)
+    ]
+
+
+def _chains(n=6, seed=5000):
+    _, level0 = generate_test(4, 40, n, 0.02, seed=seed)
+    t1a, _ = generate_test(4, 70, 1, 0.0, seed=seed + 1)
+    t1b = bytearray(t1a)
+    t1b[35] = (t1b[35] + 1) % 4
+    t1b = bytes(t1b)
+    return [
+        [level0[i],
+         corrupt(t1a if i < n // 2 else t1b, 0.02,
+                 np.random.default_rng(seed + 2 + i))]
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------ engine-level parity
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("er,min_count", [(0.02, 2), (0.08, 3)])
+def test_single_exit_reason_fuzz(seed, er, min_count, monkeypatch):
+    """Mega-on == mega-off == python oracle across workloads spanning
+    the ambiguity spectrum: 2% error barely forks (long unambiguous
+    runs — the megastep's best case), 8% at min_count 3 forks
+    constantly (the megastep exits at nearly every pop — its worst
+    case).  Both must commit the identical trail."""
+    _, reads = generate_test(4, 90, 6, er, seed=seed)
+    _set_mega(monkeypatch, False)
+    plain, _ = _single(reads, min_count=min_count)
+    _set_mega(monkeypatch, True, cols="2", blocks="4")
+    mega, counters = _single(reads, min_count=min_count)
+    assert mega == plain
+    assert counters.get("run_mega_calls", 0) > 0
+    oracle, _ = _single(reads, backend="python", min_count=min_count)
+    assert mega == oracle
+    # the fuzz family must actually traverse host-arbitration exits
+    # (stop code 1 = dirty vote / fork), not just clean completions
+    assert counters.get("run_stop_1", 0) > 0
+
+
+@pytest.mark.parametrize("m", ["1", "4"])
+@pytest.mark.parametrize("k", ["1", "4"])
+def test_mk_composition_fuzz(m, k, monkeypatch):
+    """M blocks x K columns composition: every (M, K) pairing commits
+    the same bytes as plain K=1 stepping."""
+    _, reads = generate_test(4, 100, 6, 0.04, seed=11)
+    _set_mega(monkeypatch, False, cols="1")
+    plain, _ = _single(reads)
+    _set_mega(monkeypatch, True, cols=k, blocks=m)
+    mega, counters = _single(reads)
+    assert mega == plain
+    assert counters.get("run_mega_calls", 0) > 0
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_forced_i16_mega_fuzz(seed, monkeypatch):
+    """Forced 16-bit band state under the megastep: the saturating
+    arithmetic swap must stay invisible through M x K composition."""
+    _, reads = generate_test(4, 80, 6, 0.05, seed=seed)
+    _set_mega(monkeypatch, False)
+    monkeypatch.delenv("WAFFLE_XLA_I16", raising=False)
+    plain, _ = _single(reads)
+    _set_mega(monkeypatch, True, cols="2", blocks="4", i16="1")
+    mega, _ = _single(reads)
+    assert mega == plain
+
+
+@pytest.mark.parametrize("syms", ["1", "3", "7"])
+def test_mid_megastep_stop_codes(syms, monkeypatch):
+    """A tiny per-dispatch commit budget forces every megastep to cap
+    mid-run (stop code 4): the engine must re-engage from the partial
+    trail and still finish byte-identical, with the cap visible as
+    strictly more mega dispatches than the uncapped path takes."""
+    _, reads = generate_test(4, 60, 6, 0.02, seed=31)
+    _set_mega(monkeypatch, False)
+    plain, _ = _single(reads)
+    _set_mega(monkeypatch, True, cols="2", blocks="2", syms=syms)
+    mega, counters = _single(reads)
+    assert mega == plain
+    assert counters.get("run_stop_4", 0) > 0
+    assert counters.get("run_mega_calls", 0) >= 60 // int(syms)
+
+
+def test_band_overflow_mid_megastep(monkeypatch):
+    """Stop code 5 (band overflow) inside a megastep: the engine grows
+    the band and replays, landing on the same bytes as plain stepping
+    with the same growth path."""
+    _, reads = generate_test(4, 80, 6, 0.06, seed=41)
+    _set_mega(monkeypatch, False)
+    plain, c_plain = _single(reads, initial_band=2)
+    _set_mega(monkeypatch, True, cols="2", blocks="4")
+    mega, c_mega = _single(reads, initial_band=2)
+    assert mega == plain
+    assert c_mega.get("grow_e_events", 0) > 0
+    assert c_mega.get("grow_e_events") == c_plain.get("grow_e_events")
+
+
+def test_dual_mega_parity(monkeypatch):
+    reads = _dual_reads()
+    _set_mega(monkeypatch, False)
+    plain, _ = _dual(reads)
+    _set_mega(monkeypatch, True, cols="2", blocks="4")
+    mega, counters = _dual(reads)
+    assert mega == plain
+    assert counters.get("run_mega_calls", 0) > 0
+
+
+def test_priority_mega_parity(monkeypatch):
+    """Priority chains drive the megastep through SubsetScorer (the
+    per-group read-slice adapter), so this doubles as the slicing
+    parity check for ``run_mega``."""
+    chains = _chains()
+
+    def run():
+        e = PriorityConsensusDWFA(_cfg("jax"))
+        for c in chains:
+            e.add_sequence_chain(c)
+        return e.consensus()
+
+    _set_mega(monkeypatch, False)
+    plain = run()
+    _set_mega(monkeypatch, True, cols="2", blocks="4")
+    mega = run()
+    assert mega == plain
+
+
+# ------------------------------------------------ capability gating
+
+
+def test_run_mega_property_gated(monkeypatch):
+    from waffle_con_tpu.ops.jax_scorer import JaxScorer
+    from waffle_con_tpu.ops.scorer import fast_paths, megastep_enabled
+
+    _, reads = generate_test(4, 40, 4, 0.02, seed=51)
+    scorer = JaxScorer(list(reads), _cfg("jax"))
+    monkeypatch.setenv("WAFFLE_MEGASTEP", "0")
+    assert not megastep_enabled()
+    assert scorer.run_mega is None
+    assert fast_paths(scorer).run_mega is None
+    monkeypatch.setenv("WAFFLE_MEGASTEP", "1")
+    assert megastep_enabled()
+    assert scorer.run_mega is not None
+    # fast_paths snapshots are cached on the scorer instance (keyed by
+    # the supervisor's demotion generation, not the env), so the flip
+    # is seen by a FRESH scorer — the engines build one per search
+    fresh = JaxScorer(list(reads), _cfg("jax"))
+    assert fast_paths(fresh).run_mega is not None
+
+
+def test_mega_reduces_host_round_trips(monkeypatch):
+    """The megastep's reason to exist, asserted at engine level: the
+    SAME search pays strictly fewer blocking host syncs with mega on,
+    and commits the identical trail."""
+    _, reads = generate_test(4, 120, 6, 0.01, seed=61)
+    _set_mega(monkeypatch, False)
+    plain, c_plain = _single(reads)
+    _set_mega(monkeypatch, True, cols="2", blocks="4")
+    mega, c_mega = _single(reads)
+    assert mega == plain
+    assert c_mega.get("run_mega_calls", 0) > 0
+    assert c_mega["host_round_trips"] < c_plain["host_round_trips"]
+
+
+def test_supervisor_retries_megastep_as_plain(faults, monkeypatch):
+    """A megastep dispatch whose RESULT fails validation (garbage
+    fault — fires after the kernel ran, like a real mid-megastep
+    failure) must be retried by the supervisor as PLAIN stepping (the
+    conservative path), without demoting the backend, and finish
+    byte-identical."""
+    from waffle_con_tpu.runtime import events
+
+    _set_mega(monkeypatch, True, cols="2", blocks="2")
+    _, reads = generate_test(4, 60, 5, 0.02, seed=71)
+
+    def run(cfg):
+        e = ConsensusDWFA(cfg)
+        for r in reads:
+            e.add_sequence(r)
+        return [(c.sequence, c.scores) for c in e.consensus()]
+
+    expected = run(_cfg("jax"))
+    faults.add("garbage", backend="jax", op="run", count=1)
+    got = run(_cfg(
+        "jax", backend_chain=("python",), dispatch_retries=1,
+        breaker_threshold=3, retry_backoff_s=0.0,
+    ))
+    assert got == expected
+    assert events.get_events("backend_demoted") == []
+    failed = [
+        e for e in events.get_events("dispatch_failed")
+        if e.get("op") == "run"
+    ]
+    assert failed, "injected run-result fault never surfaced"

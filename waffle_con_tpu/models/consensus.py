@@ -580,7 +580,17 @@ class ConsensusDWFA:
             # farthest frontier without replaying a step the real search
             # would have pruned.
             fp = fast_paths(scorer)
-            run_extend = fp.run_extend
+            # MEGASTEP preference: when the scorer exposes run_mega
+            # (WAFFLE_MEGASTEP on a device backend), the pop loop
+            # becomes the SPILL path — one engagement swallows an
+            # entire unambiguous stretch under a single bundled
+            # round trip, and this host loop only arbitrates the
+            # genuine events (forks, reached ends, pop losses, band
+            # growth, budget caps).  Same call contract, bit-identical
+            # results, so everything downstream is unchanged.
+            run_extend = (
+                fp.run_mega if fp.run_mega is not None else fp.run_extend
+            )
             reached_now = self._reached_end(node, cfg.allow_early_termination)
             force_sym = -1
             if run_extend is not None:

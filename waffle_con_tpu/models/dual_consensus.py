@@ -848,7 +848,11 @@ class DualConsensusDWFA:
                                     l2,
                                 )
                             (steps, _code, app1, stats1,
-                             run_records) = fp.run_extend(
+                             run_records) = (
+                                fp.run_mega
+                                if fp.run_mega is not None
+                                else fp.run_extend
+                            )(
                                 node.h1,
                                 node.consensus1,
                                 me_budget,

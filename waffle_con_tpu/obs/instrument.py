@@ -270,6 +270,19 @@ class FrontierSampler:
             fields["gang_commit_rate"] = (
                 round(gi / (gi + gm), 4) if (gi + gm) else None
             )
+            # megastep run lengths: committed symbols per mega dispatch
+            # (the quantity the megastep optimizes — long unambiguous
+            # stretches swallowed under one bundled round trip), plus
+            # the cumulative blocking-sync count the search has paid
+            mc = counters.get("run_mega_calls", 0)
+            fields["mega_calls"] = mc
+            fields["mega_syms_per_dispatch"] = (
+                round(counters.get("run_mega_steps", 0) / mc, 2)
+                if mc else None
+            )
+            fields["host_round_trips"] = counters.get(
+                "host_round_trips", 0
+            )
         if gang_width is not None:
             fields["gang_width"] = int(gang_width)
         obs_flight.record(

@@ -52,8 +52,11 @@ from waffle_con_tpu.utils import envspec
 
 PHASES = ("host_prep", "device_compute", "transfer", "host_post")
 
-#: kernel-family vocabulary for the ``kernel`` label
-KERNEL_FAMILIES = ("solo", "dual", "arena", "ragged", "pallas", "other")
+#: kernel-family vocabulary for the ``kernel`` label (``mega`` = the
+#: megastep run entries — M blocks of K columns per device iteration)
+KERNEL_FAMILIES = (
+    "solo", "dual", "arena", "ragged", "pallas", "mega", "other"
+)
 
 #: bounded ring of recently closed records kept for introspection/tests
 _RECENT_MAX = 256

@@ -71,6 +71,7 @@ from waffle_con_tpu.ops.scorer import (
     DeferredStats,
     WavefrontScorer,
     deferred_sync_enabled,
+    megastep_enabled,
 )
 
 #: Numpy (not jnp) module constants: a ``jnp`` scalar here would (a) force
@@ -128,11 +129,61 @@ def _run_cols() -> int:
     return _RUN_COLS_DEFAULT.get(jax.default_backend(), 1)
 
 
+#: default megastep composition M (blocks of K columns per while-loop
+#: iteration).  Unlike raising K — whose unrolled body doubles compile
+#: time per octave and measurably LOSES throughput past the K=4 knee
+#: (see ``_RUN_COLS_DEFAULT``) — the M blocks run through one traced
+#: ``fori_loop`` body, so M*K columns amortize the loop-condition /
+#: carry-rotation overhead at the compile cost of the K-column body.
+_MEGA_BLOCKS_DEFAULT = 8
+
+_MEGA_BLOCKS_MAX = 64
+
+_MEGA_SYMS_MAX = 1 << 20
+
+
+def _mega_blocks() -> int:
+    """Megastep blocks M per device loop iteration (the
+    ``WAFFLE_MEGA_BLOCKS`` knob, clamped 1..64).  Read per run call so
+    tests can flip it at runtime; each distinct M is a static argument
+    of ``_j_run_mega`` (its own compiled kernel)."""
+    env = envspec.get_raw("WAFFLE_MEGA_BLOCKS")
+    if env:
+        try:
+            return max(1, min(_MEGA_BLOCKS_MAX, int(env)))
+        except ValueError:
+            return 1
+    return _MEGA_BLOCKS_DEFAULT
+
+
+def _mega_syms() -> int:
+    """Per-dispatch commit budget of a megastep run (the
+    ``WAFFLE_MEGA_SYMS`` knob): caps the caller's ``max_steps``.
+    Capping is always exact — the committed prefix is identical and a
+    budget-capped run stops with code 4, which the engines already
+    treat as "re-engage from here"."""
+    env = envspec.get_raw("WAFFLE_MEGA_SYMS")
+    if env:
+        try:
+            return max(1, min(_MEGA_SYMS_MAX, int(env)))
+        except ValueError:
+            return _MEGA_SYMS_MAX
+    return 65536
+
+
 def _xla_i16_ok(L: int, C: int, W: int) -> bool:
     """True when every finite cell cost the banded DP can produce fits
     strictly under :data:`DINF16` (same bound as ``pallas_run.i16_ok``),
     so narrowing ``D`` to int16 is value-exact."""
     return max(L, C) + W + 4 < int(DINF16)
+
+
+#: band width from which megastep dispatches turn int16 band state on
+#: even on CPU (see ``JaxScorer._xla_i16``): the W=98 fixture sweep
+#: measured i16 neutral-to-slightly-worse there, while the W=434
+#: north-star geometry measured +17% — the crossover is where the
+#: ``[R, W]`` column math stops fitting cache and goes memory-bound
+_MEGA_I16_MIN_W = 256
 
 
 def _next_pow2(n: int, minimum: int = 1) -> int:
@@ -813,13 +864,8 @@ def _nominate_side(occ, split, w, wc, weighted, mc_tab, mc_dyn):
     return dirty, sym, counts, has_votes, exactable, mc, near_tie
 
 
-@partial(
-    jax.jit,
-    static_argnames=("num_symbols", "uniform", "a_real", "i16", "cols"),
-    donate_argnums=(0,),
-)
-def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
-           uniform, a_real=None, i16=False, cols=1):
+def _run_impl(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+              uniform, a_real, i16, cols, blocks):
     """Device-resident multi-symbol extension: keep appending the unique
     passing candidate while the votes are exactly reproducible host-side
     (one tip symbol per read → integer counts), stopping at any event the
@@ -908,6 +954,18 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     pre-speculation kernel.  The extra return value ``iters`` counts
     loop iterations so the host can report speculated columns
     (``iters * cols``) vs committed (``steps``).
+
+    ``blocks`` (static, the MEGASTEP composition M — see
+    :func:`_j_run_mega`) nests the K-column block inside a
+    ``lax.fori_loop`` running M blocks per ``while_loop`` iteration.
+    The nested body is ALL-masked sub-columns: a masked sub-column with
+    a running stop code of 0 is behaviorally identical to the unmasked
+    one, and the while condition guarantees code 0 at iteration entry,
+    so the composition is bit-identical to ``blocks=1`` — while the
+    fori body is traced ONCE, keeping compile cost at the K-column
+    body instead of doubling per unrolled octave like raising K does.
+    ``iters`` then counts M*K-column iterations (speculated columns =
+    ``iters * cols * blocks``).
     """
     h = params[0]
     me_budget = params[1]
@@ -1099,12 +1157,25 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
                 rec_count, rec_steps, rec_fins, code)
 
     def body(carry):
-        # speculative K-column block: sub-column 0 is the exact K=1 body
-        # (the loop condition guarantees code==0 here); the rest verify
-        # the running code before committing
-        sub = substep(carry[:-1], masked=False)
-        for _ in range(cols - 1):
-            sub = substep(sub, masked=True)
+        if blocks == 1:
+            # speculative K-column block: sub-column 0 is the exact K=1
+            # body (the loop condition guarantees code==0 here); the
+            # rest verify the running code before committing
+            sub = substep(carry[:-1], masked=False)
+            for _ in range(cols - 1):
+                sub = substep(sub, masked=True)
+        else:
+            # megastep: M blocks of K ALL-masked sub-columns through one
+            # traced fori body — masked with running code 0 is identical
+            # to unmasked (the while condition guarantees code 0 here),
+            # so this is bit-identical to blocks=1 at the compile cost
+            # of a single K-column block
+            def block(_, c):
+                for _ in range(cols):
+                    c = substep(c, masked=True)
+                return c
+
+            sub = lax.fori_loop(0, blocks, block, carry[:-1])
         return sub + (carry[-1] + 1,)
 
     D0 = state["D"][h]
@@ -1176,6 +1247,46 @@ def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("num_symbols", "uniform", "a_real", "i16", "cols"),
+    donate_argnums=(0,),
+)
+def _j_run(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+           uniform, a_real=None, i16=False, cols=1):
+    """Plain run entry: the K-column speculative loop (``blocks=1``).
+    See :func:`_run_impl` for the full contract."""
+    return _run_impl(
+        state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+        uniform, a_real, i16, cols, 1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "num_symbols", "uniform", "a_real", "i16", "cols", "blocks"
+    ),
+    donate_argnums=(0,),
+)
+def _j_run_mega(state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+                uniform, a_real=None, i16=False, cols=1,
+                blocks=_MEGA_BLOCKS_DEFAULT):
+    """MEGASTEP run entry: the outer ``while_loop`` advances the branch
+    ``blocks`` (M) blocks of ``cols`` (K) columns per iteration, folding
+    tip votes at the real alphabet width and committing the winning
+    symbol on device whenever it is unambiguous — the host sees the run
+    only at genuine decision points (fork/near-tie arbitration, reached
+    end, losing the next pop, band growth) or when the
+    ``WAFFLE_MEGA_SYMS`` dispatch budget caps it.  Bit-identical to
+    ``_j_run`` by the masked-block argument in :func:`_run_impl`; the
+    stop-code/record/forced-first-symbol contracts are unchanged."""
+    return _run_impl(
+        state, reads, reads_pad, rlen, params, wc, et, num_symbols,
+        uniform, a_real, i16, cols, blocks,
+    )
+
+
 def _dual_votes(occ, split, w, wc, weighted):
     """Per-side fractional vote fold for the dual run loop, mirroring the
     host's ``candidates_from_stats`` with per-read weights: each voting
@@ -1210,12 +1321,14 @@ def _dual_votes(occ, split, w, wc, weighted):
 
 @partial(
     jax.jit,
-    static_argnames=("num_symbols", "uniform", "a_real", "i16", "cols"),
+    static_argnames=(
+        "num_symbols", "uniform", "a_real", "i16", "cols", "blocks"
+    ),
     donate_argnums=(0,),
 )
 def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
                 wc, et, num_symbols, uniform, a_real=None, i16=False,
-                cols=1):
+                cols=1, blocks=1):
     """Device-resident extension of a *dual* node: both branches advance
     one symbol per iteration while each side's nomination is unambiguous,
     with divergence pruning (``dual_max_ed_delta``) applied on device
@@ -1273,6 +1386,12 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
     sub-steps per ``while_loop`` iteration with commit masking on the
     running stop code, bit-identical to K=1 (see ``_j_run``).  The
     extra return value ``iters`` counts loop iterations.
+
+    ``blocks`` (static): megastep composition M — ``blocks > 1`` runs M
+    blocks of K ALL-masked sub-columns through one traced ``fori_loop``
+    body per iteration, bit-identical to ``blocks=1`` (see
+    :func:`_run_impl`); ``run_extend_dual`` selects it under
+    ``WAFFLE_MEGASTEP``.
     """
     ha = params[0]
     hb = params[1]
@@ -1531,10 +1650,20 @@ def _j_run_dual(state, reads, reads_pad, rlen, params, mc_tab, imb_tab,
                 code)
 
     def body(carry):
-        # speculative K-column block (see _j_run)
-        sub = substep(carry[:-1], masked=False)
-        for _ in range(cols - 1):
-            sub = substep(sub, masked=True)
+        if blocks == 1:
+            # speculative K-column block (see _j_run)
+            sub = substep(carry[:-1], masked=False)
+            for _ in range(cols - 1):
+                sub = substep(sub, masked=True)
+        else:
+            # megastep composition (see _run_impl): M blocks of K
+            # all-masked sub-columns, bit-identical to blocks=1
+            def block(_, c):
+                for _ in range(cols):
+                    c = substep(c, masked=True)
+                return c
+
+            sub = lax.fori_loop(0, blocks, block, carry[:-1])
         return sub + (carry[-1] + 1,)
 
     R = rlen.shape[0]
@@ -2719,6 +2848,13 @@ class JaxScorer(WavefrontScorer):
             "run_dual_steps": 0,
             "run_dual_iters": 0,
             "run_dual_spec_cols": 0,
+            "run_mega_calls": 0,
+            "run_mega_steps": 0,
+            "run_dual_mega_calls": 0,
+            #: blocking device->host syncs paid by the run paths (one
+            #: per control fetch / record fetch / stats fetch-or-resolve)
+            #: — the quantity the megastep bundles down; see run_mega
+            "host_round_trips": 0,
             "arena_iters": 0,
             "arena_spec_events": 0,
             "stats_calls": 0,
@@ -3110,20 +3246,29 @@ class JaxScorer(WavefrontScorer):
             and envspec.get_raw("WAFFLE_PALLAS_I16", "1") != "0"
         )
 
-    def _xla_i16(self) -> bool:
+    def _xla_i16(self, mega: bool = False) -> bool:
         """int16 band-state narrowing for the XLA while-loop run kernels
         (mirrors the pallas ``i16`` flag): on by default only where the
         narrower tile wins — TPU, where the ``[R, W]`` loop is
         memory-bound.  CPU XLA lowers the int16 column math slower than
-        int32, so it stays off there unless forced for parity testing
-        via ``WAFFLE_XLA_I16=1``.  The narrowed path is value-exact
-        whenever the :func:`_xla_i16_ok` geometry bound holds."""
+        int32 at small band widths, so it stays off there unless forced
+        for parity testing via ``WAFFLE_XLA_I16=1``.  The narrowed path
+        is value-exact whenever the :func:`_xla_i16_ok` geometry bound
+        holds.
+
+        ``mega`` dispatches additionally opt in on ANY backend once the
+        band is wide enough that the ``[R, W]`` traffic is memory-bound
+        (measured on XLA:CPU at the north-star geometry, W=434: 878 ->
+        1025 steps/s; the small-W fixtures where int16 lowering loses
+        sit far below :data:`_MEGA_I16_MIN_W`)."""
         env = envspec.get_raw("WAFFLE_XLA_I16")
         if env == "0":
             return False
         if not _xla_i16_ok(self._L, self._C, self._W):
             return False
-        return env == "1" or jax.default_backend() == "tpu"
+        if env == "1" or jax.default_backend() == "tpu":
+            return True
+        return mega and self._W >= _MEGA_I16_MIN_W
 
     def _pallas_prep(self, longest: int, max_steps: int):
         """Shared pallas dispatch setup: bucket the SMEM symbol-buffer
@@ -3319,6 +3464,7 @@ class JaxScorer(WavefrontScorer):
         max_steps: int,
         first_sym: int = -1,
         allow_records: bool = True,
+        mega: bool = False,
     ) -> Tuple[int, int, bytes, BranchStats, list]:
         """Device-side unambiguous-run extension; returns
         ``(steps_committed, stop_code, appended_bytes, stats, records)``
@@ -3330,7 +3476,17 @@ class JaxScorer(WavefrontScorer):
         ``first_sym`` (a dense id, or -1) force-pushes the host's
         already-nominated unique child as step 0.  See ``_j_run`` for
         the stop-code contract; on overflow the band is grown so the
-        caller can simply continue stepping."""
+        caller can simply continue stepping.
+
+        ``mega`` selects the MEGASTEP dispatch (normally reached via
+        :attr:`run_mega`): the ``_j_run_mega`` kernel (M blocks of K
+        columns per loop iteration, wide-band int16 admission),
+        ``max_steps`` capped by the ``WAFFLE_MEGA_SYMS`` dispatch
+        budget, and ONE bundled result transfer — control scalars,
+        commit trail, and the stats snapshot cross the device boundary
+        together instead of control-now/stats-deferred, so a megastep
+        pop pays a single host round trip.  Results are bit-identical
+        to the plain path."""
         from waffle_con_tpu.ops import ragged as _ragged
 
         inj = _ragged.take_injected(self, h)
@@ -3400,10 +3556,16 @@ class JaxScorer(WavefrontScorer):
         self._invalidate_root_stats()
         rec = _phases.current()
         slot = self._slot_of[h]
+        if mega:
+            # the dispatch budget: stopping earlier is always exact (the
+            # capped run stops with code 4 and the engine re-engages)
+            max_steps = min(max_steps, _mega_syms())
         while len(consensus) + max_steps + 2 >= self._C:
             self._grow_cons()
         uniform, off0 = self._uniform_off(slot)
-        use_pallas = uniform and self._pallas_ok(
+        # mega IS the XLA megastep: configs where the fused pallas
+        # kernel applies keep it by running plain (WAFFLE_MEGASTEP=0)
+        use_pallas = (not mega) and uniform and self._pallas_ok(
             sides=1, ms=self._pallas_ms(max_steps)
         )
         if use_pallas:
@@ -3448,16 +3610,27 @@ class JaxScorer(WavefrontScorer):
                 iters, cols = steps, 1  # fused kernel: one col per iter
         if not use_pallas:
             cols = _run_cols()
-            _note_compile("j_run", (
-                self._B, self._R, self._W, self._C, self._L, self._A,
-                uniform, self.num_symbols, self._xla_i16(), cols,
-            ))
+            blocks = _mega_blocks() if mega else 1
+            i16 = self._xla_i16(mega=mega)
+            if mega:
+                _note_compile("j_run_mega", (
+                    self._B, self._R, self._W, self._C, self._L,
+                    self._A, uniform, self.num_symbols, i16, cols,
+                    blocks,
+                ))
+                run_fn = partial(_j_run_mega, blocks=blocks)
+            else:
+                _note_compile("j_run", (
+                    self._B, self._R, self._W, self._C, self._L,
+                    self._A, uniform, self.num_symbols, i16, cols,
+                ))
+                run_fn = _j_run
             with _phases.device_scope(rec):
-                out_dev = _j_run(
+                out_dev = run_fn(
                     self._state, self._reads, self._reads_pad,
                     self._rlen, params, self._wc, self._et, self._A,
                     uniform, a_real=self.num_symbols,
-                    i16=self._xla_i16(), cols=cols,
+                    i16=i16, cols=cols,
                 )
                 if rec is not None:
                     # profiling fences the async dispatch so device
@@ -3468,11 +3641,13 @@ class JaxScorer(WavefrontScorer):
              rec_count, rec_steps, rec_fins, iters) = out_dev
         if rec is not None:
             rec.annotate(
-                kernel="pallas" if use_pallas else "solo",
-                k=int(cols), geom=self._geom_bucket(),
+                kernel="mega" if mega else
+                ("pallas" if use_pallas else "solo"),
+                k=int(cols) * (int(blocks) if mega else 1),
+                geom=self._geom_bucket(),
             )
         self._state = state
-        defer = deferred_sync_enabled()
+        defer = deferred_sync_enabled() and not mega
         with _obs_span("device_get:run_extend", "device-sync"), \
                 _phases.transfer_scope(rec):
             # async dispatch seam: only the CONTROL results the engine's
@@ -3480,10 +3655,23 @@ class JaxScorer(WavefrontScorer):
             # the bulk observation arrays ride a DeferredStats and are
             # fetched when the branch is next popped — the bookkeeping
             # for this run (and the dispatch of the next) overlaps the
-            # outstanding transfer (see ops.scorer.DeferredStats)
-            (steps, code, cons_np, rec_count, iters) = jax.device_get(
-                (steps, code, cons_row, rec_count, iters)
-            )
+            # outstanding transfer (see ops.scorer.DeferredStats).  A
+            # MEGA dispatch instead bundles the stats snapshot into this
+            # one transfer: its dispatches are long enough that overlap
+            # is moot, and the bundle makes the common (record-free) pop
+            # cost exactly ONE host round trip.
+            stats_parts = (stats, fin_eds, fin_ovf)
+            if mega:
+                (steps, code, cons_np, rec_count, iters,
+                 stats_parts) = jax.device_get(
+                    (steps, code, cons_row, rec_count, iters,
+                     stats_parts)
+                )
+            else:
+                (steps, code, cons_np, rec_count, iters) = jax.device_get(
+                    (steps, code, cons_row, rec_count, iters)
+                )
+            self.counters["host_round_trips"] += 1
             # the record buffers only ride home when something was
             # absorbed (most run calls have none, and every fetched byte
             # costs tunnel round-trip time)
@@ -3491,15 +3679,21 @@ class JaxScorer(WavefrontScorer):
                 rec_steps_np, rec_fins_np = jax.device_get(
                     (rec_steps, rec_fins)
                 )
-            stats_parts = (stats, fin_eds, fin_ovf)
-            if not defer:
+                self.counters["host_round_trips"] += 1
+            if not defer and not mega:
                 stats_parts = jax.device_get(stats_parts)
+                self.counters["host_round_trips"] += 1
         steps = int(steps)
         code = int(code)
         self.counters["run_calls"] += 1
         self.counters["run_steps"] += steps
         self.counters["run_iters"] += int(iters)
-        self.counters["run_spec_cols"] += int(iters) * cols
+        self.counters["run_spec_cols"] += (
+            int(iters) * cols * (int(blocks) if mega else 1)
+        )
+        if mega:
+            self.counters["run_mega_calls"] += 1
+            self.counters["run_mega_steps"] += steps
         key = f"run_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
         appended = b""
@@ -3521,12 +3715,53 @@ class JaxScorer(WavefrontScorer):
             )
 
         if defer:
-            out_stats: BranchStats = DeferredStats(
-                lambda: build_stats(jax.device_get(stats_parts))
-            )
+            def _resolve():
+                # the deferred fetch is still a blocking sync when it
+                # lands — count it where it happens so host_round_trips
+                # reflects what the process actually paid
+                self.counters["host_round_trips"] += 1
+                return build_stats(jax.device_get(stats_parts))
+
+            out_stats: BranchStats = DeferredStats(_resolve)
         else:
             out_stats = build_stats(stats_parts)
         return steps, code, appended, out_stats, records
+
+    @property
+    def run_mega(self):
+        """MEGASTEP fast path, or ``None`` when ``WAFFLE_MEGASTEP=0``.
+
+        Same call contract as :meth:`run_extend`; dispatches
+        ``_j_run_mega`` (M blocks of K columns per device loop
+        iteration), caps the dispatch at the ``WAFFLE_MEGA_SYMS``
+        budget, and returns everything in one bundled transfer.  The
+        property gate (rather than an always-present method) lets the
+        ``fast_paths`` snapshot / SubsetScorer / supervisor capability
+        machinery treat it exactly like the other optional kernels —
+        engines prefer it when present and spill to plain stepping
+        otherwise.  Bit-identical to the plain path by construction."""
+        if not megastep_enabled():
+            return None
+        return self._run_mega_call
+
+    def _run_mega_call(
+        self,
+        h: int,
+        consensus: bytes,
+        me_budget: int,
+        other_cost: int,
+        other_len: int,
+        min_count: int,
+        l2: bool,
+        max_steps: int,
+        first_sym: int = -1,
+        allow_records: bool = True,
+    ) -> Tuple[int, int, bytes, BranchStats, list]:
+        return self.run_extend(
+            h, consensus, me_budget, other_cost, other_len, min_count,
+            l2, max_steps, first_sym=first_sym,
+            allow_records=allow_records, mega=True,
+        )
 
     def run_extend_dual(
         self,
@@ -3639,9 +3874,16 @@ class JaxScorer(WavefrontScorer):
                 iters, cols = steps, 1  # fused kernel: one col per iter
         if not use_pallas:
             cols = _run_cols()
+            # the dual twin rides the same megastep composition: M
+            # blocks per iteration and wide-band int16, env-gated here
+            # because the engines' dual call site has no separate mega
+            # entry (the kernel change is blocks>1, nothing else)
+            mega = megastep_enabled()
+            blocks = _mega_blocks() if mega else 1
+            i16 = self._xla_i16(mega=mega)
             _note_compile("j_run_dual", (
                 self._B, self._R, self._W, self._C, self._L, self._A,
-                uni1 and uni2, self.num_symbols, self._xla_i16(), cols,
+                uni1 and uni2, self.num_symbols, i16, cols, blocks,
             ))
             with _phases.device_scope(rec):
                 out_dev = _j_run_dual(
@@ -3649,8 +3891,8 @@ class JaxScorer(WavefrontScorer):
                     self._rlen, params,
                     np.ascontiguousarray(mc_tab, dtype=np.int32),
                     imb_tab, self._wc, self._et, self._A, uni1 and uni2,
-                    a_real=self.num_symbols, i16=self._xla_i16(),
-                    cols=cols,
+                    a_real=self.num_symbols, i16=i16,
+                    cols=cols, blocks=blocks,
                 )
                 if rec is not None:
                     # profiling fences the async dispatch (see
@@ -3659,10 +3901,13 @@ class JaxScorer(WavefrontScorer):
             (state, steps, code, stats1, stats2, act1, act2, consa,
              consb, rec_count, rec_steps, rec_f1, rec_f2, rec_a1,
              rec_a2, iters) = out_dev
+        else:
+            mega, blocks = False, 1
         if rec is not None:
             rec.annotate(
-                kernel="pallas" if use_pallas else "dual",
-                k=int(cols), geom=self._geom_bucket(),
+                kernel="pallas" if use_pallas else
+                ("mega" if mega else "dual"),
+                k=int(cols) * int(blocks), geom=self._geom_bucket(),
             )
         self._state = state
         defer = deferred_sync_enabled()
@@ -3677,19 +3922,24 @@ class JaxScorer(WavefrontScorer):
                 (steps, code, act1, act2, consa, consb,
                  rec_count, iters)
             )
+            self.counters["host_round_trips"] += 1
             if int(rec_count):
                 (rec_steps_np, rec_f1_np, rec_f2_np, rec_a1_np,
                  rec_a2_np) = jax.device_get(
                     (rec_steps, rec_f1, rec_f2, rec_a1, rec_a2)
                 )
+                self.counters["host_round_trips"] += 1
             if not defer:
                 stats1, stats2 = jax.device_get((stats1, stats2))
+                self.counters["host_round_trips"] += 1
         steps = int(steps)
         code = int(code)
         self.counters["run_dual_calls"] += 1
         self.counters["run_dual_steps"] += steps
         self.counters["run_dual_iters"] += int(iters)
-        self.counters["run_dual_spec_cols"] += int(iters) * cols
+        self.counters["run_dual_spec_cols"] += int(iters) * cols * blocks
+        if mega:
+            self.counters["run_dual_mega_calls"] += 1
         key = f"run_dual_stop_{code}"
         self.counters[key] = self.counters.get(key, 0) + 1
 
@@ -3720,12 +3970,16 @@ class JaxScorer(WavefrontScorer):
         if code == 5:
             self._grow_e()
         if defer:
-            out1: BranchStats = DeferredStats(
-                lambda: self._stats_np(jax.device_get(stats1))
-            )
-            out2: BranchStats = DeferredStats(
-                lambda: self._stats_np(jax.device_get(stats2))
-            )
+            def _resolve_side(side_stats):
+                def _resolve():
+                    # count the landing sync (see run_extend)
+                    self.counters["host_round_trips"] += 1
+                    return self._stats_np(jax.device_get(side_stats))
+
+                return _resolve
+
+            out1: BranchStats = DeferredStats(_resolve_side(stats1))
+            out2: BranchStats = DeferredStats(_resolve_side(stats2))
         else:
             out1 = self._stats_np(stats1)
             out2 = self._stats_np(stats2)

@@ -207,6 +207,17 @@ def deferred_sync_enabled() -> bool:
     return envspec.get_raw("WAFFLE_ASYNC_SYNC", "1") != "0"
 
 
+def megastep_enabled() -> bool:
+    """Whether the engines' pop loop engages the MEGASTEP run path
+    (``WAFFLE_MEGASTEP``, default on; ``0`` restores plain
+    ``run_extend`` stepping).  Read when a scorer's ``run_mega``
+    capability property is resolved (each fresh engine / ``fast_paths``
+    snapshot), so tests flipping it per-search see it; results are
+    bit-identical either way — the knob trades per-pop host round
+    trips against kernel variety (one extra compile per geometry)."""
+    return envspec.get_raw("WAFFLE_MEGASTEP", "1") != "0"
+
+
 #: counter names that each correspond to one blocking device dispatch;
 #: the dispatch-evidence script and the regression tests sum these so
 #: the budget they enforce is the same quantity the evidence records
@@ -604,8 +615,30 @@ class SubsetScorer(WavefrontScorer):
             return None
         return self._run_arena
 
+    @property
+    def run_mega(self):
+        # megastep twin of run_extend: same contract, so the same
+        # sliced-view adapter applies (the base property is also the
+        # WAFFLE_MEGASTEP gate — None propagates through the view)
+        if getattr(self.base, "run_mega", None) is None:
+            return None
+        return self._run_mega
+
     def _run_extend(self, h, consensus, *args, **kwargs):
         steps, code, appended, stats, records = self.base.run_extend(
+            h, consensus, *args, **kwargs
+        )
+        idx = self.indices
+        return (
+            steps,
+            code,
+            appended,
+            self._slice(stats),
+            [(j, fin[idx]) for j, fin in records],
+        )
+
+    def _run_mega(self, h, consensus, *args, **kwargs):
+        steps, code, appended, stats, records = self.base.run_mega(
             h, consensus, *args, **kwargs
         )
         idx = self.indices
@@ -664,7 +697,7 @@ class FastPaths:
     """
 
     __slots__ = (
-        "gen", "run_extend", "run_extend_dual", "run_arena",
+        "gen", "run_extend", "run_extend_dual", "run_arena", "run_mega",
         "clone_push_many", "arena_cap", "arena_k", "arena_cre_per_event",
         "arena_take_max",
     )
@@ -674,6 +707,7 @@ class FastPaths:
         self.run_extend = getattr(scorer, "run_extend", None)
         self.run_extend_dual = getattr(scorer, "run_extend_dual", None)
         self.run_arena = getattr(scorer, "run_arena", None)
+        self.run_mega = getattr(scorer, "run_mega", None)
         self.clone_push_many = getattr(scorer, "clone_push_many", None)
         self.arena_cap = getattr(scorer, "ARENA_CAP", 0)
         self.arena_k = getattr(scorer, "ARENA_K", 1)

@@ -65,7 +65,10 @@ def _metric_inc(name: str, **labels) -> None:
 _HEALTH_ORDER = ("jax", "native", "python")
 
 #: optional fast paths engines feature-test per pop (see models/*)
-_FAST_PATHS = ("run_extend", "run_extend_dual", "run_arena", "clone_push_many")
+_FAST_PATHS = (
+    "run_extend", "run_extend_dual", "run_arena", "clone_push_many",
+    "run_mega",
+)
 
 
 class DispatchTimeout(RuntimeError):
@@ -625,6 +628,10 @@ class BackendSupervisor(WavefrontScorer):
         return self._run_arena if self._capabilities["run_arena"] else None
 
     @property
+    def run_mega(self):
+        return self._run_mega if self._capabilities["run_mega"] else None
+
+    @property
     def clone_push_many(self):
         if not self._capabilities["clone_push_many"]:
             return None
@@ -653,6 +660,33 @@ class BackendSupervisor(WavefrontScorer):
                 # demoted to a backend without the kernel: report a
                 # zero-step stop; the engine adopts the (identical)
                 # snapshot and falls through to the expand path
+                return (
+                    0, 0, b"",
+                    self._scorer.stats(self._bh(h), consensus), [],
+                )
+            return fn(self._bh(h), consensus, *args, **kwargs)
+
+        result = self._supervised("run", [h], call)
+        steps = result[0]
+        if steps > 0:
+            self._ledger[h].consensus = bytes(consensus) + result[2]
+        return result
+
+    def _run_mega(self, h, consensus, *args, **kwargs):
+        attempts = {"n": 0}
+
+        def call():
+            # a FAILED megastep retries as plain stepping: the retry
+            # (attempt > 1) or a demotion to a backend without the mega
+            # kernel falls back to run_extend — identical results, the
+            # supervisor just loses the round-trip bundling for this
+            # dispatch — and a backend with neither kernel reports a
+            # zero-step stop exactly like _run_extend's fallback
+            attempts["n"] += 1
+            fn = getattr(self._scorer, "run_mega", None)
+            if fn is None or attempts["n"] > 1:
+                fn = getattr(self._scorer, "run_extend", None)
+            if fn is None:
                 return (
                     0, 0, b"",
                     self._scorer.stats(self._bh(h), consensus), [],

@@ -85,6 +85,19 @@ KNOBS: Dict[str, EnvKnob] = dict((
     _k("WAFFLE_RUN_COLS", "int", "unset (per-backend, 4)",
        "Speculative columns K per device loop iteration, clamped "
        "1..64; read per dispatch"),
+    _k("WAFFLE_MEGASTEP", "flag", "1 (on)",
+       "Device-resident megastep runs: the engines' pop loop engages "
+       "`run_mega` (M blocks of K columns per while-loop iteration, "
+       "one bundled result transfer); `0` restores plain `run_extend` "
+       "stepping"),
+    _k("WAFFLE_MEGA_SYMS", "int", "65536",
+       "Per-dispatch commit budget of a megastep run (caps max_steps; "
+       "a capped run stops with code 4 and the engine re-engages), "
+       "clamped 1..1048576"),
+    _k("WAFFLE_MEGA_BLOCKS", "int", "8",
+       "Megastep blocks M per while-loop iteration (each block is K "
+       "masked columns; traced once, so compile cost stays at the K=1 "
+       "body), clamped 1..64"),
     # -- search / frontier speculation ---------------------------------
     _k("WAFFLE_FRONTIER_M", "int", "unset (adaptive)",
        "Explicit frontier-gang width M; `0`/`1` disable speculation"),
