@@ -275,12 +275,16 @@ def _obs_iter_end(tracer, engine, dt, reports, slowest):
 def _obs_finish(out, tracer, trace_out, reports, slowest):
     """Attach the obs evidence to the bench line and write the trace."""
     from waffle_con_tpu.obs import metrics_enabled, registry
+    from waffle_con_tpu.obs import audit as obs_audit
 
     if reports:
         out["search_report"] = reports[-1]
         out["search_reports"] = reports
     if metrics_enabled():
         out["metrics"] = registry().snapshot()
+    audit_status = obs_audit.status()
+    if audit_status is not None:
+        out["audit"] = audit_status
     if tracer is not None and trace_out:
         tracer.write_chrome_trace(trace_out, events=slowest[1])
         out["trace_out"] = trace_out

@@ -19,6 +19,13 @@ python scripts/waffle_lint.py --strict
 echo "== tier-1 suite (sharded) =="
 python scripts/run_suite.py "$@"
 
+echo "== search audit drill (lockstep shadow + seeded divergence triage) =="
+# clean lockstep shadow over golden fixtures must report zero
+# divergences; then a deterministic flip_vote fault must be localized to
+# its exact pop by the shadow, the offline differ, and a minimized
+# checkpoint-resume repro (scripts/waffle_diverge.py --drill).
+WAFFLE_AUDIT=1 python scripts/waffle_diverge.py --drill
+
 echo "== bench smoke (metrics + trace) =="
 SMOKE_OUT="$(mktemp /tmp/waffle_ci_bench.XXXXXX.json)"
 TRACE_OUT="$(mktemp /tmp/waffle_ci_trace.XXXXXX.json)"

@@ -1,0 +1,390 @@
+#!/usr/bin/env python
+"""waffle_diverge: first-divergence triage for the search audit plane.
+
+Companion CLI to ``waffle_con_tpu/obs/audit.py`` — the three triage
+verbs, plus the seeded-divergence CI drill:
+
+``diff A.jsonl B.jsonl``
+    Align two decision audit logs (jax-vs-python, mega-on-vs-off,
+    K=4-vs-K=1, resumed-vs-scratch, ...) as order-independent decision
+    maps and print the first conflicting decision: exact pop index on
+    both sides, both records, and the node identity at that point.
+    Exit 0 when the logs agree on every shared decision, 3 when they
+    diverge.
+
+``minimize`` (drill-internal; see ``--drill``)
+    Shrink a diverging run to its last few pops: snapshot the search
+    through the checkpoint seam a few pops before the first divergence
+    and emit a self-contained repro JSON (checkpoint wire form + the
+    fault spec + the expected divergence).
+
+``replay REPRO.json``
+    Resume the repro's checkpoint through the ``resume`` seam with the
+    recorded fault armed and the python lockstep shadow engaged; exit 0
+    when the recorded divergence reproduces at the same decision within
+    the pop budget, 3 otherwise.
+
+``--drill``
+    The CI self-test (``scripts/ci.sh``): clean lockstep shadow over
+    golden fixtures must report zero divergences; then a deterministic
+    ``flip_vote`` fault (``runtime/faults.py``) flips one committed
+    vote on the jax engine and the drill asserts the shadow aborts with
+    exactly one ``parity_divergence`` flight incident, the offline
+    differ localizes the same pop, and the minimized repro replays to
+    the same divergence in <= 10 pops.
+
+Everything runs in-process without mutating the environment (the audit
+``capture``/``shadow_override`` seams), so the drill composes with any
+ambient WAFFLE_* configuration CI sets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fail(msg: str) -> "SystemExit":
+    print(f"waffle_diverge: FAIL: {msg}")
+    return SystemExit(2)
+
+
+def cmd_diff(path_a: str, path_b: str) -> int:
+    from waffle_con_tpu.obs import audit as obs_audit
+
+    detail = obs_audit.diff_logs(
+        obs_audit.load_log(path_a), obs_audit.load_log(path_b)
+    )
+    if detail is None:
+        print(json.dumps({"divergence": None}))
+        return 0
+    print(json.dumps({"divergence": detail}, indent=2, default=repr))
+    return 3
+
+
+def _arm_fault(fault: dict):
+    from waffle_con_tpu.runtime import faults as faults_mod
+
+    plan = faults_mod.install(faults_mod.FaultPlan())
+    plan.add(
+        fault["kind"],
+        backend=fault.get("backend", "*"),
+        op=fault.get("op", "*"),
+        at=fault.get("at"),
+        count=fault.get("count", 1),
+    )
+    return plan
+
+
+def _replay_repro(repro: dict) -> dict:
+    """Resume the repro checkpoint with its fault armed under the python
+    lockstep shadow; returns the observed divergence detail (raises
+    SystemExit(2) when nothing diverges)."""
+    from waffle_con_tpu.models import checkpoint as ckpt_mod
+    from waffle_con_tpu.obs import audit as obs_audit
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.runtime import faults as faults_mod
+
+    checkpoint = ckpt_mod.SearchCheckpoint.from_wire(repro["checkpoint"])
+    engine = ckpt_mod.resume_engine(checkpoint)
+    _arm_fault(repro["fault"])
+    obs_flight.reset()
+    try:
+        with obs_audit.shadow_override("python"):
+            engine.consensus()
+    except obs_audit.ParityDivergence as exc:
+        return exc.detail
+    finally:
+        faults_mod.clear()
+    raise _fail("repro replayed without any divergence")
+
+
+def cmd_replay(path: str) -> int:
+    with open(path) as fh:
+        repro = json.load(fh)
+    detail = _replay_repro(repro)
+    expect = repro.get("expect", {})
+    ok_key = list(detail.get("key", [])) == list(expect.get("key", []))
+    budget = repro.get("budget_pops", 10)
+    resumed_pops = detail.get("pop_a", 0) - repro.get("ckpt_pops", 0)
+    ok_budget = resumed_pops <= budget
+    print(json.dumps({
+        "divergence": detail, "expected_key": expect.get("key"),
+        "key_match": ok_key, "resumed_pops": resumed_pops,
+        "budget_pops": budget,
+    }, indent=2, default=repr))
+    return 0 if (ok_key and ok_budget) else 3
+
+
+# -- the seeded-divergence CI drill ------------------------------------
+
+#: single-engine drill reads: a clean 3-vs-3 fork at position 2, then a
+#: long unambiguous tail — plain branch pops through the fork, device
+#: runs down the tail (so the fault lands mid-run territory)
+DRILL_READS = [
+    b"ACGTTGCAACGTTGCAACGT",
+    b"ACGTTGCAACGTTGCAACGT",
+    b"ACGTTGCAACGTTGCAACGT",
+    b"ACCTTGCAACGTTGCAACGT",
+    b"ACCTTGCAACGTTGCAACGT",
+    b"ACCTTGCAACGTTGCAACGT",
+]
+
+
+def _single_engine(backend: str):
+    from waffle_con_tpu import ConsensusDWFA
+    from waffle_con_tpu.config import CdwfaConfig
+
+    engine = ConsensusDWFA(CdwfaConfig(backend=backend))
+    for read in DRILL_READS:
+        engine.add_sequence(read)
+    return engine
+
+
+def _drill_clean_shadow() -> None:
+    from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+    from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+    from waffle_con_tpu.obs import audit as obs_audit
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.utils.fixtures import load_dual_fixture
+
+    obs_flight.reset()
+    obs_audit.reset_stats()
+    with obs_audit.shadow_override("python"):
+        _single_engine("jax").consensus()
+        seqs, _expected = load_dual_fixture(
+            "dual_001", True, ConsensusCost.L1_DISTANCE
+        )
+        dual = DualConsensusDWFA(CdwfaConfig(backend="jax"))
+        for s in seqs:
+            dual.add_sequence(s)
+        dual.consensus()
+    snap = obs_audit.stats_snapshot()
+    if snap["divergences"] != 0:
+        raise _fail(f"clean shadow reported divergences: {snap}")
+    if snap["shadow_pops"] <= 0:
+        raise _fail("clean shadow compared zero pops")
+    incidents = [
+        i for i in obs_flight.incidents()
+        if i.get("reason") == "parity_divergence"
+    ]
+    if incidents:
+        raise _fail(f"clean shadow fired {len(incidents)} incidents")
+    print(
+        f"waffle_diverge: clean shadow OK "
+        f"({snap['shadow_pops']} pops compared, 0 divergences)"
+    )
+
+
+def _drill_find_target() -> int:
+    """Baseline jax capture: the consensus length of the first device
+    run (a pop where exactly one symbol passes) — where ``flip_vote``
+    will deterministically land."""
+    from waffle_con_tpu.obs import audit as obs_audit
+
+    with obs_audit.capture(strict_align=True) as sinks:
+        _single_engine("jax").consensus()
+    runs = [
+        r for r in sinks[0].records
+        if r["kind"] == "run" and r.get("forced")
+    ]
+    if not runs:
+        raise _fail("baseline jax run produced no forced run records")
+    preferred = [r for r in runs if r["pop"] >= 3]
+    target = (preferred or runs)[0]
+    print(
+        f"waffle_diverge: fault target: consensus length {target['len']} "
+        f"(baseline pop {target['pop']})"
+    )
+    return int(target["len"])
+
+
+def _drill_seeded_shadow(length: int) -> dict:
+    from waffle_con_tpu.obs import audit as obs_audit
+    from waffle_con_tpu.obs import flight as obs_flight
+    from waffle_con_tpu.runtime import faults as faults_mod
+
+    obs_flight.reset()
+    obs_audit.reset_stats()
+    _arm_fault({"kind": "flip_vote", "backend": "jax", "op": "vote",
+                "at": length, "count": 1})
+    try:
+        with obs_audit.shadow_override("python"):
+            _single_engine("jax").consensus()
+        raise _fail("seeded shadow did not abort on the flipped vote")
+    except obs_audit.ParityDivergence as exc:
+        detail = exc.detail
+    finally:
+        faults_mod.clear()
+    incidents = [
+        i for i in obs_flight.incidents()
+        if i.get("reason") == "parity_divergence"
+    ]
+    if len(incidents) != 1:
+        raise _fail(
+            f"expected exactly one parity_divergence incident, "
+            f"got {len(incidents)}"
+        )
+    key = detail.get("key") or []
+    if not key or key[0] != "s" or key[1] != length:
+        raise _fail(f"divergence key {key} is not at length {length}")
+    print(
+        f"waffle_diverge: shadow aborted at pop {detail['pop_a']} "
+        f"(key={key}, one incident) — streaming parity works"
+    )
+    return detail
+
+
+def _drill_offline_diff(length: int, shadow_detail: dict) -> None:
+    from waffle_con_tpu.obs import audit as obs_audit
+    from waffle_con_tpu.runtime import faults as faults_mod
+
+    _arm_fault({"kind": "flip_vote", "backend": "jax", "op": "vote",
+                "at": length, "count": 1})
+    try:
+        with obs_audit.capture(strict_align=True) as sinks:
+            _single_engine("jax").consensus()
+    finally:
+        faults_mod.clear()
+    jax_records = sinks[0].records
+    with obs_audit.capture(strict_align=True) as sinks:
+        _single_engine("python").consensus()
+    detail = obs_audit.diff_logs(jax_records, sinks[0].records)
+    if detail is None:
+        raise _fail("offline differ missed the seeded divergence")
+    if detail["pop_a"] != shadow_detail["pop_a"]:
+        raise _fail(
+            f"differ pop {detail['pop_a']} != shadow pop "
+            f"{shadow_detail['pop_a']}"
+        )
+    if list(detail["key"]) != list(shadow_detail["key"]):
+        raise _fail(
+            f"differ key {detail['key']} != shadow key "
+            f"{shadow_detail['key']}"
+        )
+    print(
+        f"waffle_diverge: offline differ localized the same divergence "
+        f"(pop {detail['pop_a']})"
+    )
+
+
+def _drill_minimize(length: int, detail: dict) -> str:
+    """Snapshot the seeded run a few pops before the divergence and
+    write the self-contained repro JSON; returns its path."""
+    from waffle_con_tpu.models import checkpoint as ckpt_mod
+    from waffle_con_tpu.obs import audit as obs_audit
+    from waffle_con_tpu.runtime import faults as faults_mod
+
+    # poll ordinals are completed-pop counts; record pops are 1-based,
+    # so the divergent iteration is poll D-1 — snapshot 3 polls earlier
+    ckpt_pops = max(0, int(detail["pop_a"]) - 4)
+    ctrl = ckpt_mod.CheckpointController(
+        snapshot_at_pops={ckpt_pops}, preempt=True, label="diverge-min"
+    )
+    _arm_fault({"kind": "flip_vote", "backend": "jax", "op": "vote",
+                "at": length, "count": 1})
+    checkpoint = None
+    try:
+        with ckpt_mod.installed(ctrl):
+            with obs_audit.capture(strict_align=True):
+                try:
+                    _single_engine("jax").consensus()
+                except ckpt_mod.SearchPreempted as exc:
+                    checkpoint = exc.checkpoint
+    finally:
+        faults_mod.clear()
+    if checkpoint is None:
+        raise _fail(
+            f"minimizer run was not preempted at pop {ckpt_pops}"
+        )
+    repro = {
+        "schema": "waffle-diverge-repro/1",
+        "checkpoint": checkpoint.to_wire(),
+        "ckpt_pops": ckpt_pops,
+        "fault": {"kind": "flip_vote", "backend": "jax", "op": "vote",
+                  "at": length, "count": 1},
+        "expect": {"pop": detail["pop_a"], "key": list(detail["key"])},
+        "budget_pops": 10,
+    }
+    fd, path = tempfile.mkstemp(
+        prefix="waffle-diverge-repro-", suffix=".json"
+    )
+    with os.fdopen(fd, "w") as fh:
+        json.dump(repro, fh)
+    print(
+        f"waffle_diverge: minimized repro at {path} "
+        f"(checkpoint at pop {ckpt_pops}, expect divergence at "
+        f"pop {detail['pop_a']})"
+    )
+    return path
+
+
+def _drill_replay(path: str) -> None:
+    with open(path) as fh:
+        repro = json.load(fh)
+    detail = _replay_repro(repro)
+    expect = repro["expect"]
+    if list(detail["key"]) != list(expect["key"]):
+        raise _fail(
+            f"replayed divergence key {detail['key']} != recorded "
+            f"{expect['key']}"
+        )
+    resumed_pops = int(detail["pop_a"]) - int(repro["ckpt_pops"])
+    if resumed_pops > int(repro["budget_pops"]):
+        raise _fail(
+            f"replay took {resumed_pops} pops "
+            f"(> budget {repro['budget_pops']})"
+        )
+    print(
+        f"waffle_diverge: repro replayed to the same divergence in "
+        f"{resumed_pops} pops (pop {detail['pop_a']}, key match)"
+    )
+
+
+def cmd_drill() -> int:
+    _drill_clean_shadow()
+    length = _drill_find_target()
+    detail = _drill_seeded_shadow(length)
+    _drill_offline_diff(length, detail)
+    repro_path = _drill_minimize(length, detail)
+    try:
+        _drill_replay(repro_path)
+    finally:
+        try:
+            os.unlink(repro_path)
+        except OSError:
+            pass
+    print("waffle_diverge: drill PASSED")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="command")
+    p_diff = sub.add_parser("diff", help="first divergence of two logs")
+    p_diff.add_argument("log_a")
+    p_diff.add_argument("log_b")
+    p_replay = sub.add_parser("replay", help="replay a minimized repro")
+    p_replay.add_argument("repro")
+    parser.add_argument(
+        "--drill", action="store_true",
+        help="run the seeded-divergence CI self-test",
+    )
+    args = parser.parse_args()
+    if args.drill:
+        return cmd_drill()
+    if args.command == "diff":
+        return cmd_diff(args.log_a, args.log_b)
+    if args.command == "replay":
+        return cmd_replay(args.repro)
+    parser.error("nothing to do: pass a subcommand or --drill")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,15 @@ def render(payload: dict, plain: bool = False) -> str:
             f"store={cache.get('results', 0)}r/"
             f"{cache.get('checkpoints', 0)}c"
         )
+    audit = payload.get("audit")
+    if audit:
+        shadow = audit.get("shadow") or "off"
+        lines.append(
+            f"audit: records={audit.get('records', 0)} "
+            f"shadow={shadow} "
+            f"shadow_pops={audit.get('shadow_pops', 0)} "
+            f"divergences={audit.get('divergences', 0)}"
+        )
 
     replicas = payload.get("replicas") or stats.get("replicas")
     if replicas:

@@ -1,0 +1,316 @@
+"""Search audit plane: decision recorder, first-divergence differ, and
+lockstep shadow execution (``obs/audit.py``).
+
+Covers the zero-cost-when-disabled contract (the audit decision is made
+once at search start; no digest work ever runs when off), the per-pop
+decision records each engine emits and their expansion into comparable
+units, the ring bound and JSONL stream modes, the order-independent
+first-divergence differ, clean lockstep shadow runs over both engines,
+a seeded ``flip_vote`` divergence aborting the shadow with exactly one
+``parity_divergence`` flight incident, and the
+``waffle_audit_records_total`` metrics counter.
+"""
+
+import numpy as np
+import pytest
+
+from waffle_con_tpu import (
+    CdwfaConfigBuilder,
+    ConsensusDWFA,
+    DualConsensusDWFA,
+)
+from waffle_con_tpu.obs import audit as obs_audit
+from waffle_con_tpu.obs import flight as obs_flight
+from waffle_con_tpu.obs import metrics as obs_metrics
+from waffle_con_tpu.ops.scorer import construct_backend
+
+#: a clean 2-vs-1 fork then an unambiguous tail: branch pops through the
+#: fork, device runs down the tail
+SINGLE_READS = (
+    b"ACGTTGCAACGTTGCA",
+    b"ACGTTGCAACGTTGCA",
+    b"ACCTTGCAACGTTGCA",
+)
+
+DUAL_READS = (
+    b"ACGTTGCAACGTTGCA",
+    b"ACGTTGCAACGTTGCA",
+    b"ACGTAGCAACGTTGCA",
+    b"ACGTAGCAACGTTGCA",
+)
+
+
+def _cfg(backend, **kw):
+    b = CdwfaConfigBuilder().min_count(kw.pop("min_count", 1)).backend(backend)
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return b.build()
+
+
+def _single(backend, reads=SINGLE_READS, **kw):
+    engine = ConsensusDWFA(_cfg(backend, **kw))
+    for r in reads:
+        engine.add_sequence(r)
+    return engine
+
+
+def _dual(backend, reads=DUAL_READS, **kw):
+    engine = DualConsensusDWFA(_cfg(backend, min_count=2, **kw))
+    for r in reads:
+        engine.add_sequence(r)
+    return engine
+
+
+# ------------------------------------------------- zero-overhead guard
+
+
+def test_disabled_search_sink_is_none():
+    # WAFFLE_AUDIT unset in tier-1 runs -> the one flag read per search
+    assert obs_audit.search_sink("single") is None
+    assert not obs_audit.audit_enabled()
+
+
+def test_disabled_maybe_tap_returns_scorer_unchanged():
+    scorer = construct_backend(list(SINGLE_READS), _cfg("python"), "python")
+    assert obs_audit.maybe_tap(scorer, "python") is scorer
+
+
+def test_disabled_search_does_no_digest_work(monkeypatch):
+    """The zero-overhead contract, deterministically: with audit off the
+    engines must never reach any digest helper, so poisoning them all is
+    invisible to a search."""
+
+    def _poison(*_a, **_k):  # pragma: no cover - must never run
+        raise AssertionError("audit digest work ran with audit disabled")
+
+    for name in ("crc_bytes", "active_digest", "b64", "tail"):
+        monkeypatch.setattr(obs_audit, name, _poison)
+    results = _single("python").consensus()
+    assert results and results[0].sequence
+
+
+def test_enabled_search_reaches_digests(monkeypatch):
+    """Counter-probe for the poison test: with capture installed the
+    same search DOES hit the digest helpers."""
+    hits = []
+    real = obs_audit.crc_bytes
+    monkeypatch.setattr(
+        obs_audit, "crc_bytes", lambda *a: hits.append(1) or real(*a)
+    )
+    with obs_audit.capture():
+        _single("python").consensus()
+    assert hits
+
+
+# -------------------------------------------------- decision recording
+
+
+def test_capture_python_single_records():
+    with obs_audit.capture() as sinks:
+        results = _single("python").consensus()
+    assert results
+    (sink,) = sinks
+    assert sink.engine == "single"
+    kinds = {r["kind"] for r in sink.records}
+    assert "branch" in kinds and "final" in kinds
+    pops = [r["pop"] for r in sink.records if "pop" in r]
+    assert pops == sorted(pops)
+    seqs = [r["seq"] for r in sink.records]
+    assert seqs == list(range(len(seqs)))
+    units = []
+    for rec in sink.records:
+        units.extend(obs_audit.expand_units(rec))
+    assert units  # every decision expands into comparable units
+    for key, value in units:
+        assert key[0] in ("s", "p", "d")
+
+
+def test_capture_dual_records_have_specs():
+    with obs_audit.capture() as sinks:
+        _dual("python").consensus()
+    (sink,) = sinks
+    assert sink.engine == "dual"
+    branch = [r for r in sink.records if r["kind"] == "branch"]
+    assert branch and all("specs" in r for r in branch)
+    final = [r for r in sink.records if r["kind"] == "final"]
+    assert final and all("imbalanced" in r for r in final)
+
+
+def test_jax_run_records_and_dispatch_tap():
+    with obs_audit.capture() as sinks:
+        _single("jax").consensus()
+    (sink,) = sinks
+    kinds = {r["kind"] for r in sink.records}
+    assert "run" in kinds  # device runs recorded at the pop boundary
+    taps = [r for r in sink.records if r["kind"] == "dispatch"]
+    assert taps and all(
+        r["op"] in obs_audit._TAPPED_OPS and r["backend"] == "jax"
+        for r in taps
+    )
+    runs = [r for r in sink.records if r["kind"] == "run"]
+    for rec in runs:
+        assert rec["via"] in ("run", "mega")
+        assert isinstance(rec["code"], int)
+
+
+def test_ring_bound():
+    sink = obs_audit.AuditSink("single", ring=4)
+    for i in range(10):
+        sink.emit({"kind": "ignored", "pop": i})
+    assert len(sink.records) == 4
+    assert [r["pop"] for r in sink.records] == [6, 7, 8, 9]
+    assert sink.records[-1]["seq"] == 9  # seq keeps counting past the cap
+
+
+def test_env_file_mode_streams_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setenv("WAFFLE_AUDIT", "1")
+    monkeypatch.setenv("WAFFLE_AUDIT_DIR", str(tmp_path))
+    monkeypatch.setenv("WAFFLE_AUDIT_RING", "5")
+    _single("python").consensus()
+    logs = sorted(tmp_path.glob("audit-*-single.jsonl"))
+    assert len(logs) == 1
+    records = obs_audit.load_log(str(logs[0]))
+    assert records and all(r["eng"] == "single" for r in records)
+    # the stream keeps everything; the in-memory ring stays bounded
+    with obs_audit._RECENT_LOCK:
+        sink = obs_audit._RECENT[-1]
+    assert len(sink.records) <= 5 <= len(records)
+
+
+def test_priority_group_markers():
+    from waffle_con_tpu.models.priority_consensus import (
+        PriorityConsensusDWFA,
+    )
+
+    engine = PriorityConsensusDWFA(_cfg("python", min_count=1))
+    for r in DUAL_READS:
+        engine.add_sequence_chain([r])
+    with obs_audit.capture() as sinks:
+        engine.consensus()
+    pri = [s for s in sinks if s.engine == "priority"]
+    assert pri
+    groups = [r for r in pri[0].records if r["kind"] == "group"]
+    assert groups and all(
+        {"level", "include", "size"} <= set(r) for r in groups
+    )
+
+
+# ------------------------------------------------ first-divergence diff
+
+
+def test_diff_logs_identical_and_cross_backend():
+    with obs_audit.capture(strict_align=True) as sinks:
+        _single("python").consensus()
+        _single("jax").consensus()
+    py, jx = sinks
+    assert obs_audit.diff_logs(py.records, py.records) is None
+    # byte-parity invariant: jax run units line up with oracle branches
+    assert obs_audit.diff_logs(py.records, jx.records) is None
+
+
+def test_diff_logs_localizes_tampered_decision():
+    import copy
+
+    with obs_audit.capture() as sinks:
+        _single("python").consensus()
+    records = sinks[0].records
+    tampered = copy.deepcopy(records)
+    victim = next(r for r in tampered if r["kind"] == "branch")
+    syms = bytearray(obs_audit.unb64(victim["syms"]))
+    syms[0] = (syms[0] + 1) % 256
+    victim["syms"] = obs_audit.b64(bytes(sorted(syms)))
+    detail = obs_audit.diff_logs(records, tampered)
+    assert detail is not None
+    assert detail["pop_a"] == victim["pop"]
+    assert detail["key"][1] == victim["len"]
+    assert detail["value_a"] != detail["value_b"]
+
+
+# --------------------------------------------------- lockstep shadowing
+
+
+def test_clean_shadow_single_and_dual():
+    obs_flight.reset()
+    obs_audit.reset_stats()
+    with obs_audit.shadow_override("python"):
+        single = _single("jax").consensus()
+        dual = _dual("jax").consensus()
+    assert single and dual
+    snap = obs_audit.stats_snapshot()
+    assert snap["divergences"] == 0
+    assert snap["shadow_pops"] > 0
+    assert not [
+        i for i in obs_flight.incidents()
+        if i.get("reason") == "parity_divergence"
+    ]
+
+
+def test_shadow_noop_for_python_backend():
+    obs_audit.reset_stats()
+    with obs_audit.shadow_override("python"):
+        _single("python").consensus()  # oracle IS the primary: no shadow
+    assert obs_audit.stats_snapshot()["shadow_pops"] == 0
+
+
+def test_seeded_flip_vote_aborts_shadow_once(faults):
+    # find where the jax engine commits a forced run, then flip that vote
+    with obs_audit.capture(strict_align=True) as sinks:
+        _single("jax").consensus()
+    runs = [
+        r for r in sinks[0].records
+        if r["kind"] == "run" and r.get("forced")
+    ]
+    assert runs, "workload produced no forced device runs"
+    length = runs[0]["len"]
+    faults.add("flip_vote", backend="jax", op="vote", at=length, count=1)
+    obs_flight.reset()
+    obs_audit.reset_stats()
+    with pytest.raises(obs_audit.ParityDivergence) as err:
+        with obs_audit.shadow_override("python"):
+            _single("jax").consensus()
+    detail = err.value.detail
+    assert detail["key"][0] == "s" and detail["key"][1] == length
+    assert detail["value_a"] != detail["value_b"]
+    assert obs_audit.stats_snapshot()["divergences"] == 1
+    incidents = [
+        i for i in obs_flight.incidents()
+        if i.get("reason") == "parity_divergence"
+    ]
+    assert len(incidents) == 1  # exactly one, despite streaming feeds
+
+
+# ----------------------------------------------------- metrics & status
+
+
+@pytest.fixture
+def metrics_on():
+    obs_metrics.enable_metrics(True)
+    obs_metrics.registry().reset()
+    try:
+        yield
+    finally:
+        obs_metrics.reset_metrics_enabled()
+        obs_metrics.registry().reset()
+
+
+def test_audit_records_counter_when_metrics_on(metrics_on):
+    with obs_audit.capture():
+        _single("python").consensus()
+    snap = obs_metrics.registry().snapshot()
+    series = snap["waffle_audit_records_total"]["series"]
+    assert series['{engine="single"}'] > 0
+
+
+def test_status_none_when_fully_inactive():
+    obs_audit.reset_stats()
+    assert obs_audit.status() is None
+
+
+def test_status_reports_activity():
+    obs_audit.reset_stats()
+    with obs_audit.capture():
+        _single("python").consensus()
+    status = obs_audit.status()
+    assert status is not None
+    assert status["records"] > 0
+    assert status["enabled"] is False and status["shadow"] is None

@@ -6,6 +6,8 @@ min-counts chosen to exercise the device fast paths (runs, arenas,
 forced pushes, fused expansions, on-device discards) against their
 per-symbol oracle flow."""
 
+import json
+
 import numpy as np
 import pytest
 
@@ -33,6 +35,20 @@ def _cfg(backend, rng, **over):
     return b.build()
 
 
+def _assert_parity(tag, want, got):
+    """Parity assertion with audit triage: when the decision audit plane
+    is on (``WAFFLE_AUDIT=1``) a mismatch first dumps both engines'
+    decision logs plus their first-divergence diff as a bundle under
+    ``WAFFLE_AUDIT_DIR`` (see ``scripts/waffle_diverge.py diff``), so a
+    red fuzz run leaves enough behind to triage without a rerun."""
+    if want == got:
+        return
+    from waffle_con_tpu.obs import audit as obs_audit
+
+    bundle = obs_audit.dump_parity_bundle(tag) if obs_audit.audit_enabled() else None
+    assert want == got, f"parity mismatch [{tag}] (audit bundle: {bundle})"
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_single_engine_fuzz(seed):
     rng = np.random.default_rng(1000 + seed)
@@ -53,9 +69,11 @@ def test_single_engine_fuzz(seed):
         engines.append(e)
     want = engines[0].consensus()
     got = engines[1].consensus()
-    assert [(c.sequence, c.scores) for c in want] == [
-        (c.sequence, c.scores) for c in got
-    ]
+    _assert_parity(
+        f"single-fuzz-{seed}",
+        [(c.sequence, c.scores) for c in want],
+        [(c.sequence, c.scores) for c in got],
+    )
 
 
 @pytest.mark.parametrize("seed", range(8))
@@ -85,7 +103,40 @@ def test_dual_engine_fuzz(seed):
         for r in reads:
             e.add_sequence(r)
         engines.append(e)
-    assert engines[0].consensus() == engines[1].consensus()
+    _assert_parity(
+        f"dual-fuzz-{seed}", engines[0].consensus(), engines[1].consensus()
+    )
+
+
+def test_parity_bundle_dump(tmp_path, monkeypatch):
+    """A parity failure with audit enabled leaves a parseable triage
+    bundle (both decision logs + their first-divergence diff) under
+    ``WAFFLE_AUDIT_DIR``."""
+    monkeypatch.setenv("WAFFLE_AUDIT", "1")
+    monkeypatch.setenv("WAFFLE_AUDIT_DIR", str(tmp_path))
+    from waffle_con_tpu.obs import audit as obs_audit
+
+    truth, reads = generate_test(4, 60, 5, 0.02, seed=77)
+    for backend in ("python", "jax"):
+        e = ConsensusDWFA(
+            _cfg(backend, np.random.default_rng(7), min_count=2)
+        )
+        for r in reads:
+            e.add_sequence(r)
+        e.consensus()
+    with pytest.raises(AssertionError, match="audit bundle"):
+        _assert_parity("bundle-selftest", ["want"], ["got"])
+    bundle = tmp_path / "bundle-bundle-selftest"
+    assert bundle.is_dir(), sorted(p.name for p in tmp_path.iterdir())
+    logs = sorted(bundle.glob("log-*.jsonl"))
+    assert len(logs) == 2
+    for log in logs:
+        records = obs_audit.load_log(str(log))
+        assert records and all("kind" in r for r in records)
+    diff = json.loads((bundle / "diff.json").read_text())
+    assert diff["tag"] == "bundle-selftest"
+    # same workload on both engines: decision maps agree, no divergence
+    assert diff["diff"] is None
 
 
 @pytest.mark.parametrize("seed", range(4))

@@ -276,6 +276,34 @@ def test_render_cache_panel_absent_without_cache_stats(waffle_top):
     assert "cache:" not in out
 
 
+def test_render_audit_panel(waffle_top):
+    payload = _payload()
+    payload["audit"] = {
+        "records": 123, "shadow_pops": 45, "divergences": 0,
+        "enabled": True, "shadow": "python",
+    }
+    out = waffle_top.render(payload, plain=True)
+    assert "audit: records=123" in out
+    assert "shadow=python" in out
+    assert "shadow_pops=45" in out and "divergences=0" in out
+
+
+def test_render_audit_panel_shadow_off(waffle_top):
+    payload = _payload()
+    payload["audit"] = {
+        "records": 7, "shadow_pops": 0, "divergences": 0,
+        "enabled": True, "shadow": None,
+    }
+    out = waffle_top.render(payload, plain=True)
+    assert "audit: records=7" in out and "shadow=off" in out
+
+
+def test_render_audit_panel_absent_without_audit_field(waffle_top):
+    # audit plane off -> the service publishes no "audit" key -> no line
+    out = waffle_top.render(_payload(), plain=True)
+    assert "audit:" not in out
+
+
 def test_render_fleet_section_absent_without_fleet_field(waffle_top):
     # a pre-fleet door payload (workers but no "fleet") must render the
     # worker table only — no fleet rollup, no crash

@@ -27,11 +27,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.obs import audit as obs_audit
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.models import checkpoint as ckpt_mod
 from waffle_con_tpu.models.frontier import FrontierSpeculator, GangMember
+from waffle_con_tpu.runtime import faults as faults_mod
 from waffle_con_tpu.ops.scorer import (
     BranchStats,
     WavefrontScorer,
@@ -489,6 +491,9 @@ class ConsensusDWFA:
             )
         frontier = FrontierSampler("single")
         speculator = FrontierSpeculator(scorer, cfg)
+        #: decision audit sink (``None`` when WAFFLE_AUDIT is off — the
+        #: zero-overhead decision, made once per search)
+        audit = obs_audit.search_sink("single")
 
         ctrl = ckpt_mod.current_controller()
 
@@ -556,6 +561,13 @@ class ConsensusDWFA:
             top_cost = -priority[0]
             top_len = len(node.consensus)
             tracker.remove(top_len)
+            if audit is not None:
+                # node identity digests: host bytes/flags the engine
+                # already owns (WL002: nothing new is fetched)
+                a_dig = obs_audit.crc_bytes(node.consensus)
+                a_act = obs_audit.active_digest(
+                    i for i, a in enumerate(node.active) if a
+                )
 
             if (
                 top_cost > maximum_error
@@ -563,6 +575,11 @@ class ConsensusDWFA:
                 or tracker.at_capacity(top_len)
             ):
                 nodes_ignored += 1
+                if audit is not None:
+                    audit.emit({
+                        "kind": "ignored", "pop": pops, "len": top_len,
+                        "dig": a_dig, "act": a_act, "prio": top_cost,
+                    })
                 self._drop_prefetch(scorer, node)
                 scorer.free(node.handle)
                 continue
@@ -613,6 +630,11 @@ class ConsensusDWFA:
                         <= fp.arena_cre_per_event
                     )
                     and fp.run_arena is not None
+                    # under lockstep shadow the arena's opaque subtree
+                    # absorption would hide per-pop decisions from the
+                    # comparator; strict alignment skips it (byte-safe:
+                    # the arena is a pure fast path)
+                    and not (audit is not None and audit.strict_align)
                     # a pending frontier-gang deposit is this pop's run
                     # already paid for; the arena would drop it unspent
                     and not speculator.pending(node.handle)
@@ -627,6 +649,14 @@ class ConsensusDWFA:
                          arena_explored, arena_ignored) = arena
                         nodes_explored += arena_explored
                         nodes_ignored += arena_ignored
+                        if audit is not None:
+                            audit.emit({
+                                "kind": "arena", "pop": pops,
+                                "len": top_len, "dig": a_dig,
+                                "act": a_act, "prio": top_cost,
+                                "explored": arena_explored,
+                                "ignored": arena_ignored,
+                            })
                         continue
                 best_other = pqueue.peek_priority()
                 other_cost = 2**31 - 1
@@ -634,6 +664,21 @@ class ConsensusDWFA:
                 if best_other is not None:
                     other_cost = -best_other[0]
                     other_len = best_other[1]
+                if (
+                    len(passing_now) == 1
+                    and not reached_now
+                    and len(scorer.symtab) > 1
+                    and faults_mod.maybe_flip_vote(cfg.backend, top_len)
+                ):
+                    # injected wrong *decision* (``flip_vote`` fault):
+                    # silently commit a different alphabet symbol than
+                    # the nomination voted for — invisible to dispatch
+                    # validation, catchable only by the audit plane
+                    self._drop_prefetch(scorer, node)
+                    wrong = (
+                        scorer.sym_id[passing_now[0]] + 1
+                    ) % len(scorer.symtab)
+                    passing_now = [int(scorer.symtab[wrong])]
                 # -- forced-child fold: with exactly one passing symbol
                 # and no prefetched children, the expand path's outcome
                 # is fully known host-side (one child = consensus + sym,
@@ -739,6 +784,22 @@ class ConsensusDWFA:
                     # as-is), so adopt it either way — its fin field
                     # saves the finalize dispatch at a reached-end pop
                     node.stats = run_stats
+                    if audit is not None and steps > 0:
+                        audit.emit({
+                            "kind": "run", "pop": pops, "len": top_len,
+                            "dig": a_dig, "act": a_act, "prio": top_cost,
+                            "via": (
+                                "mega" if fp.run_mega is not None
+                                else "run"
+                            ),
+                            "code": int(_code),
+                            "forced": force_sym >= 0,
+                            "syms": obs_audit.b64(appended),
+                            "finals": [int(rj) for rj, _ in records],
+                            "tail": obs_audit.tail(
+                                node.consensus + appended
+                            ),
+                        })
                     if steps > 0:
                         # the branch advanced past the prefetched children
                         self._drop_prefetch(scorer, node)
@@ -789,6 +850,12 @@ class ConsensusDWFA:
                     Consensus(node.consensus, cost, fin_scores),
                     cfg.max_return_size,
                 )
+                if audit is not None:
+                    audit.emit({
+                        "kind": "final", "pop": pops, "len": top_len,
+                        "dig": a_dig, "act": a_act,
+                        "score": sum(fin_scores),
+                    })
 
             # -- nominate + expand (with frontier-synchronous batching:
             # the popped node's children and the next best queued nodes'
@@ -809,6 +876,13 @@ class ConsensusDWFA:
                 )
             passing, expansion = node.prefetch
             node.prefetch = None
+            if audit is not None:
+                audit.emit({
+                    "kind": "branch", "pop": pops, "len": top_len,
+                    "dig": a_dig, "act": a_act, "prio": top_cost,
+                    "syms": obs_audit.b64(bytes(sorted(passing))),
+                    "tail": obs_audit.tail(node.consensus),
+                })
 
             new_nodes: List[_Node] = []
             if not passing:

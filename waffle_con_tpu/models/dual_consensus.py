@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from waffle_con_tpu.config import CdwfaConfig, ConsensusCost
+from waffle_con_tpu.obs import audit as obs_audit
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.instrument import FrontierSampler
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
@@ -483,6 +484,9 @@ class DualConsensusDWFA:
             )
         frontier = FrontierSampler("dual")
         speculator = FrontierSpeculator(scorer, cfg)
+        #: decision audit sink (``None`` when WAFFLE_AUDIT is off — the
+        #: zero-overhead decision, made once per search)
+        audit = obs_audit.search_sink("dual")
 
         ctrl = ckpt_mod.current_controller()
 
@@ -569,6 +573,24 @@ class DualConsensusDWFA:
                 threshold_cutoff = single_tracker.threshold()
                 at_capacity = single_tracker.at_capacity(top_len)
 
+            if audit is not None:
+                # node identity digests: host bytes/flags the engine
+                # already owns (WL002: nothing new is fetched)
+                a_cls = "d" if node.is_dual else "p"
+                a_l1 = len(node.consensus1)
+                a_l2 = len(node.consensus2) if node.is_dual else None
+                a_d1 = obs_audit.crc_bytes(node.consensus1)
+                a_d2 = (
+                    obs_audit.crc_bytes(node.consensus2)
+                    if node.is_dual else None
+                )
+                _acts = [[i for i, a in enumerate(node.active1) if a]]
+                if node.is_dual:
+                    _acts.append(
+                        [i for i, a in enumerate(node.active2) if a]
+                    )
+                a_act = obs_audit.active_digest(*_acts)
+
             check_invariant(top_len < len(active_min_count), "active_min_count covers popped length")
             if (
                 top_cost > maximum_error
@@ -577,6 +599,12 @@ class DualConsensusDWFA:
                 or node.is_dual_imbalanced(active_min_count[top_len])
             ):
                 nodes_ignored += 1
+                if audit is not None:
+                    audit.emit({
+                        "kind": "ignored", "pop": pops, "cls": a_cls,
+                        "l1": a_l1, "l2": a_l2, "d1": a_d1, "d2": a_d2,
+                        "act": a_act, "prio": top_cost,
+                    })
                 self._free_node(scorer, node)
                 continue
 
@@ -718,6 +746,11 @@ class DualConsensusDWFA:
                 and not reached_now
                 and not (node.is_dual and (node.lock1 or node.lock2))
                 and fp.run_arena is not None
+                # under lockstep shadow the arena's opaque subtree
+                # absorption would hide per-pop decisions from the
+                # comparator; strict alignment skips it (byte-safe:
+                # the arena is a pure fast path)
+                and not (audit is not None and audit.strict_align)
                 # a pending frontier-gang deposit is this pop's run
                 # already paid for; the arena would drop it unspent
                 and not speculator.pending(node.h1)
@@ -736,6 +769,14 @@ class DualConsensusDWFA:
                      arena_explored, arena_ignored) = arena
                     nodes_explored += arena_explored
                     nodes_ignored += arena_ignored
+                    if audit is not None:
+                        audit.emit({
+                            "kind": "arena", "pop": pops, "cls": a_cls,
+                            "l1": a_l1, "l2": a_l2, "d1": a_d1,
+                            "d2": a_d2, "act": a_act, "prio": top_cost,
+                            "explored": arena_explored,
+                            "ignored": arena_ignored,
+                        })
                     continue
             if runnable:
                 best_other = pqueue.peek_priority()
@@ -883,6 +924,21 @@ class DualConsensusDWFA:
                                     maximum_error, results, rec_total,
                                     rec_result, cfg.max_return_size,
                                 )
+                        if audit is not None and steps > 0:
+                            audit.emit({
+                                "kind": "run", "pop": pops, "cls": a_cls,
+                                "l1": a_l1, "l2": a_l2, "d1": a_d1,
+                                "d2": a_d2, "act": a_act,
+                                "prio": top_cost, "code": int(_code),
+                                "s1": obs_audit.b64(app1),
+                                "s2": (
+                                    obs_audit.b64(app2)
+                                    if node.is_dual else None
+                                ),
+                                "tail": obs_audit.tail(
+                                    node.consensus1 + app1
+                                ),
+                            })
                         if steps > 0:
                             # the branches advanced past the prefetched children
                             self._drop_prefetch(scorer, node)
@@ -967,6 +1023,13 @@ class DualConsensusDWFA:
                     )
                 else:
                     logger.debug("Finalized node is imbalanced, ignoring.")
+                if audit is not None:
+                    audit.emit({
+                        "kind": "final", "pop": pops, "cls": a_cls,
+                        "l1": a_l1, "l2": a_l2, "d1": a_d1, "d2": a_d2,
+                        "act": a_act, "score": int(fin_total),
+                        "imbalanced": imbalanced,
+                    })
 
             # -- maintain the dynamic active-count tables -------------
             _extend_active_tables(
@@ -983,6 +1046,17 @@ class DualConsensusDWFA:
                 single_tracker,
                 dual_tracker,
                 cost,
+                audit=audit,
+                audit_ctx=(
+                    {
+                        "kind": "branch", "pop": pops, "cls": a_cls,
+                        "l1": a_l1, "l2": a_l2, "d1": a_d1, "d2": a_d2,
+                        "act": a_act, "prio": top_cost,
+                        "tail": obs_audit.tail(node.consensus1),
+                    }
+                    if audit is not None
+                    else None
+                ),
             )
             self._free_node(scorer, node)
 
@@ -1965,6 +2039,8 @@ class DualConsensusDWFA:
         single_tracker,
         dual_tracker,
         cost,
+        audit=None,
+        audit_ctx=None,
     ) -> None:
         cfg = self.config
 
@@ -1977,6 +2053,17 @@ class DualConsensusDWFA:
             self._materialize_expansions(scorer, [node] + peers)
         specs, children = node.prefetch
         node.prefetch = None
+        if audit is not None and audit_ctx is not None:
+            record = dict(audit_ctx)
+            record["specs"] = [
+                [
+                    kind,
+                    None if a is None else int(a),
+                    None if b is None else int(b),
+                ]
+                for kind, a, b in specs
+            ]
+            audit.emit(record)
 
         # -- finishing (pop time): activations, batched pruning, queueing
         deactivations: List[Tuple[int, int]] = []

@@ -23,6 +23,7 @@ from waffle_con_tpu.models.consensus import (
     check_invariant,
 )
 from waffle_con_tpu.models.dual_consensus import DualConsensusDWFA
+from waffle_con_tpu.obs import audit as obs_audit
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs.report import run_reported_search as _reported_search
 from waffle_con_tpu.ops.scorer import SubsetScorer, make_scorer
@@ -165,6 +166,10 @@ class PriorityConsensusDWFA:
              groups_solved, pending) = self._restore_worklist(restore)
 
         ctrl = ckpt_mod.current_controller()
+        #: decision audit sink (``None`` when WAFFLE_AUDIT is off); the
+        #: worklist emits one ``group`` marker per group solve — the
+        #: inner dual searches record their own per-pop streams
+        audit = obs_audit.search_sink("priority")
         include_set: List[bool] = []
         current_split_level = 0
         current_chain: List[Consensus] = []
@@ -237,6 +242,19 @@ class PriorityConsensusDWFA:
                     obs_metrics.registry().gauge(
                         "waffle_search_queue_depth", engine="priority"
                     ).set(len(to_split))
+
+            if audit is not None:
+                # one marker per group solve: the worklist's decision
+                # unit (the inner dual search emits its own per-pop
+                # records through its own sink)
+                audit.emit({
+                    "kind": "group", "pop": groups_solved,
+                    "level": current_split_level,
+                    "include": obs_audit.active_digest(
+                        i for i, inc in enumerate(include_set) if inc
+                    ),
+                    "size": sum(1 for inc in include_set if inc),
+                })
 
             injected = None
             if share_scorer:

@@ -64,6 +64,7 @@ TRIGGER_REASONS = (
     "slow_search",
     "worker_lost",
     "checkpoint_rejected",
+    "parity_divergence",
 )
 
 DEFAULT_RING_SIZE = 2048

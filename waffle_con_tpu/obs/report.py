@@ -112,6 +112,7 @@ def run_reported_search(engine, engine_label: str, impl: Callable):
     at INFO when ``config.log_search_summary`` is set, else at DEBUG.
     """
     # lazy submodule imports keep obs.report importable mid-package-init
+    from waffle_con_tpu.obs import audit as obs_audit
     from waffle_con_tpu.obs import flight as obs_flight
     from waffle_con_tpu.obs import metrics as obs_metrics
     from waffle_con_tpu.obs import phases as obs_phases
@@ -123,9 +124,13 @@ def run_reported_search(engine, engine_label: str, impl: Callable):
     phases_before = (
         obs_phases.totals() if obs_phases.profiling_enabled() else None
     )
+    #: lockstep shadow execution (WAFFLE_SHADOW=python, debug tool —
+    #: never enabled in serve paths): the python-oracle twin runs in
+    #: step with this search and per-pop decisions are compared
+    shadow = obs_audit.maybe_shadow(engine, engine_label)
     t0 = time.perf_counter()
     with tracer.span("search", "search", engine=engine_label):
-        results = impl()
+        results = impl() if shadow is None else shadow.run(impl)
     wall_s = time.perf_counter() - t0
 
     stats = getattr(engine, "last_search_stats", None) or {}

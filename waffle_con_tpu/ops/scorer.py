@@ -772,9 +772,10 @@ def construct_backend(
         scorer = NativeScorer(reads, config)
     else:
         raise ValueError(f"unknown backend {backend!r}")
+    from waffle_con_tpu.obs.audit import maybe_tap
     from waffle_con_tpu.obs.instrument import maybe_instrument
 
-    return maybe_instrument(scorer, backend)
+    return maybe_tap(maybe_instrument(scorer, backend), backend)
 
 
 #: thread-local scorer decoration (see :func:`set_scorer_decorator`)

@@ -35,6 +35,13 @@ Fault kinds:
 * ``cache_corrupt`` — ``enable_compilation_cache`` flips bytes in one
   persistent cache entry before integrity verification runs,
   modelling on-disk corruption from a crashed writer.
+* ``flip_vote`` — the single-engine pop loop (via
+  :func:`maybe_flip_vote`) silently replaces the sole passing symbol
+  with a different alphabet symbol before committing it: a wrong
+  *decision*, invisible to the supervisor's validation, that only the
+  audit plane (``obs/audit.py`` lockstep shadow / differ) can catch.
+  The poll index is the node's consensus length, so a length-pinned
+  rule replays deterministically through checkpoint resume.
 """
 
 from __future__ import annotations
@@ -52,6 +59,7 @@ from waffle_con_tpu.utils import envspec
 
 FAULT_KINDS = (
     "timeout", "device_loss", "garbage", "pallas_compile", "cache_corrupt",
+    "flip_vote",
 )
 
 
@@ -245,6 +253,21 @@ def maybe_corrupt_cache(path: str) -> Optional[str]:
         f.write(bytes(b ^ 0xFF for b in data[mid : mid + 16]) or b"\xff")
     events.record("cache_corruption_injected", entry=names[0])
     return names[0]
+
+
+def maybe_flip_vote(backend: str, length: int) -> bool:
+    """Single-engine pop-loop hook: ``True`` when a ``flip_vote`` fault
+    is armed for this backend at this consensus length (the poll
+    ``index`` is the popped node's consensus length, so a length-pinned
+    rule re-fires deterministically on a checkpoint-resume replay).  The
+    engine only polls at pops where a flip can commit (exactly one
+    passing symbol), so a ``count=1`` rule lands on the first such pop —
+    the seeded-divergence drill in ``scripts/waffle_diverge.py`` relies
+    on both properties."""
+    plan = active()
+    if plan is None:
+        return False
+    return plan.poll(backend, "vote", length, kinds=("flip_vote",)) is not None
 
 
 def mangle_stats(result):

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from waffle_con_tpu.obs import audit as obs_audit
 from waffle_con_tpu.obs import flight as obs_flight
 from waffle_con_tpu.obs import metrics as obs_metrics
 from waffle_con_tpu.obs import phases as obs_phases
@@ -720,6 +721,9 @@ class ConsensusService:
         }
         if obs_metrics.metrics_enabled():
             payload["metrics"] = obs_metrics.registry().snapshot()
+        audit_status = obs_audit.status()
+        if audit_status is not None:
+            payload["audit"] = audit_status
         try:
             tmp = f"{path}.tmp-{os.getpid()}"
             with open(tmp, "w") as fh:

@@ -143,6 +143,18 @@ KNOBS: Dict[str, EnvKnob] = dict((
        "Slow-search threshold: k x rolling p95"),
     _k("WAFFLE_STATS_FILE", "path", "unset (off)",
        "Serving stats snapshot file, atomically rewritten each refresh"),
+    _k("WAFFLE_AUDIT", "flag", "0 (off)",
+       "Search decision audit log: engines emit one record per pop "
+       "boundary (zero-overhead no-op when unset)"),
+    _k("WAFFLE_AUDIT_DIR", "path", "unset (in-memory ring only)",
+       "Directory receiving `audit-<n>-<engine>.jsonl` streams and "
+       "parity dump-on-fail bundles"),
+    _k("WAFFLE_AUDIT_RING", "int", "4096",
+       "Per-search audit record ring capacity"),
+    _k("WAFFLE_SHADOW", "str", "unset (off)",
+       "`python` runs the oracle engine in lockstep with the primary "
+       "and aborts at the first decision divergence (debug tool — "
+       "never enable in serve paths)"),
     _k("WAFFLE_PERFDB", "path", "evidence/perfdb.jsonl",
        "Performance-history database path override"),
     # -- CI / scripts (read by scripts/ci.sh and helpers) --------------
